@@ -1,0 +1,269 @@
+"""Crypto-core tests: field towers, curve groups, pairing, hash-to-curve.
+
+Mirrors the reference's per-package unit-test level (SURVEY.md §4): plain
+deterministic unit tests, goldens between fast and slow paths.
+"""
+
+import hashlib
+
+import pytest
+
+from drand_tpu.crypto.fields import (
+    P,
+    R,
+    Fp,
+    Fp2,
+    Fp6,
+    Fp12,
+    fp_inv,
+    fp_sqrt,
+    fr_inv,
+    fr_mul,
+)
+from drand_tpu.crypto.curves import PointG1, PointG2, H1, H2
+from drand_tpu.crypto.pairing import (
+    final_exponentiation,
+    final_exponentiation_slow,
+    miller_loop,
+    multi_pairing,
+    pairing,
+    pairing_check,
+)
+from drand_tpu.crypto.hash_to_curve import (
+    DEFAULT_DST_G2,
+    expand_message_xmd,
+    hash_to_field_fp2,
+    hash_to_g2,
+    map_to_curve_g2,
+)
+
+
+# ---------------------------------------------------------------------------
+# fields
+# ---------------------------------------------------------------------------
+
+def _rand_fp2(seed: int) -> Fp2:
+    h = hashlib.sha256(b"fp2%d" % seed).digest() + hashlib.sha256(b"fp2b%d" % seed).digest()
+    return Fp2(int.from_bytes(h[:32], "big"), int.from_bytes(h[32:], "big"))
+
+
+def _rand_fp12(seed: int) -> Fp12:
+    return Fp12(
+        Fp6(_rand_fp2(seed), _rand_fp2(seed + 100), _rand_fp2(seed + 200)),
+        Fp6(_rand_fp2(seed + 300), _rand_fp2(seed + 400), _rand_fp2(seed + 500)),
+    )
+
+
+class TestFields:
+    def test_fp_inverse(self):
+        for a in (1, 2, 12345, P - 1):
+            assert a * fp_inv(a) % P == 1
+
+    def test_fp_sqrt_roundtrip(self):
+        for a in (4, 9, 1234567):
+            r = fp_sqrt(a * a % P)
+            assert r is not None and r * r % P == a * a % P
+
+    def test_fp2_field_axioms(self):
+        a, b, c = _rand_fp2(1), _rand_fp2(2), _rand_fp2(3)
+        assert a * (b + c) == a * b + a * c
+        assert (a * b) * c == a * (b * c)
+        assert a * a.inverse() == Fp2.one()
+        assert a.square() == a * a
+
+    def test_fp2_sqrt(self):
+        for i in range(5):
+            a = _rand_fp2(i)
+            sq = a.square()
+            r = sq.sqrt()
+            assert r is not None and r.square() == sq
+
+    def test_fp2_frobenius_is_pth_power(self):
+        a = _rand_fp2(7)
+        assert a.frobenius() == a.pow(P)
+
+    def test_fp6_axioms(self):
+        a = Fp6(_rand_fp2(1), _rand_fp2(2), _rand_fp2(3))
+        b = Fp6(_rand_fp2(4), _rand_fp2(5), _rand_fp2(6))
+        assert a * a.inverse() == Fp6.one()
+        assert a * b == b * a
+        assert a.mul_by_v() == a * Fp6(Fp2.zero(), Fp2.one(), Fp2.zero())
+
+    def test_fp12_axioms(self):
+        a, b = _rand_fp12(1), _rand_fp12(2)
+        assert a * a.inverse() == Fp12.one()
+        assert a * b == b * a
+        assert a.square() == a * a
+
+    def test_fp12_frobenius(self):
+        a = _rand_fp12(3)
+        assert a.frobenius(1) == a.pow(P)
+        assert a.frobenius(2) == a.pow(P).pow(P)
+
+    def test_cyclotomic_square_matches_square(self):
+        # put an element into the cyclotomic subgroup first
+        f = _rand_fp12(4)
+        f1 = f.conjugate() * f.inverse()
+        m = f1.frobenius(2) * f1
+        assert m.cyclotomic_square() == m.square()
+        assert m.cyclotomic_pow(987654321) == m.pow(987654321)
+
+    def test_fr(self):
+        assert fr_mul(3, fr_inv(3)) == 1
+        assert fr_mul(R - 1, R - 1) == 1  # (-1)^2
+
+
+# ---------------------------------------------------------------------------
+# curves
+# ---------------------------------------------------------------------------
+
+class TestCurves:
+    def test_generators_valid(self):
+        for cls in (PointG1, PointG2):
+            g = cls.generator()
+            assert g.is_on_curve()
+            assert g.mul(R).is_infinity()
+
+    def test_group_law(self):
+        for cls in (PointG1, PointG2):
+            g = cls.generator()
+            assert g.mul(5) + g.mul(7) == g.mul(12)
+            assert g.mul(5) - g.mul(5) == cls.infinity()
+            assert g.double() == g + g
+            assert (g + g.mul(3)).mul(2) == g.mul(8)
+
+    def test_infinity_arithmetic(self):
+        g = PointG1.generator()
+        inf = PointG1.infinity()
+        assert g + inf == g
+        assert inf + g == g
+        assert inf.double() == inf
+        assert g.mul(0) == inf
+
+    def test_serialization_roundtrip(self):
+        for cls in (PointG1, PointG2):
+            g = cls.generator()
+            for k in (1, 2, 777, R - 1):
+                p = g.mul(k)
+                b = p.to_bytes()
+                assert len(b) == cls.COMPRESSED_SIZE
+                assert cls.from_bytes(b) == p
+            # infinity
+            assert cls.from_bytes(cls.infinity().to_bytes()).is_infinity()
+
+    def test_serialization_both_signs(self):
+        g = PointG2.generator()
+        p = g.mul(42)
+        assert PointG2.from_bytes((-p).to_bytes()) == -p
+
+    def test_deserialize_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            PointG1.from_bytes(b"\x00" * 48)  # no compression flag
+        with pytest.raises(ValueError):
+            PointG1.from_bytes(b"\x80" + b"\xff" * 47)  # x >= p
+
+    def test_known_generator_bytes(self):
+        # zcash-format generator encodings (well-known constants)
+        g1b = PointG1.generator().to_bytes()
+        assert g1b.hex().startswith("97f1d3a73197d7942695638c4fa9ac0f")
+        g2b = PointG2.generator().to_bytes()
+        assert len(g2b) == 96 and g2b[0] & 0x80
+
+    def test_cofactors(self):
+        # cofactor-cleared random curve points are in the r-subgroup
+        assert H1 * R != 0 and H2 * R != 0
+        g2 = PointG2.generator()
+        assert g2.clear_cofactor() == g2.mul(H2 % R) or g2.clear_cofactor().in_subgroup()
+
+
+# ---------------------------------------------------------------------------
+# pairing
+# ---------------------------------------------------------------------------
+
+class TestPairing:
+    def test_non_degenerate(self):
+        e = pairing(PointG1.generator(), PointG2.generator())
+        assert not e.is_one()
+        assert e.pow(R) == Fp12.one()  # lands in the order-r subgroup
+
+    def test_bilinearity(self):
+        g1, g2 = PointG1.generator(), PointG2.generator()
+        e = pairing(g1, g2)
+        assert pairing(g1.mul(6), g2.mul(35)) == e.pow(210)
+        assert pairing(g1.mul(6), g2.mul(35)) == pairing(g1.mul(35), g2.mul(6))
+        assert pairing(g1.mul(2), g2) == e.square()
+
+    def test_final_exp_fast_matches_slow(self):
+        g1, g2 = PointG1.generator(), PointG2.generator()
+        f = miller_loop([(g1.mul(3), g2.mul(5))])
+        assert final_exponentiation(f) == final_exponentiation_slow(f)
+
+    def test_multi_pairing_is_product(self):
+        g1, g2 = PointG1.generator(), PointG2.generator()
+        lhs = multi_pairing([(g1.mul(3), g2.mul(4)), (g1.mul(5), g2.mul(6))])
+        rhs = pairing(g1, g2).pow(3 * 4 + 5 * 6)
+        assert lhs == rhs
+
+    def test_pairing_check(self):
+        g1, g2 = PointG1.generator(), PointG2.generator()
+        assert pairing_check([(g1.mul(11), g2), (-g1, g2.mul(11))])
+        assert not pairing_check([(g1.mul(11), g2), (-g1, g2.mul(12))])
+
+    def test_infinity_pairs_skipped(self):
+        g1, g2 = PointG1.generator(), PointG2.generator()
+        assert multi_pairing([(PointG1.infinity(), g2)]).is_one()
+        assert multi_pairing([(g1, PointG2.infinity())]).is_one()
+
+
+# ---------------------------------------------------------------------------
+# hash-to-curve
+# ---------------------------------------------------------------------------
+
+class TestHashToCurve:
+    def test_expand_message_xmd_shape(self):
+        out = expand_message_xmd(b"msg", b"DST", 128)
+        assert len(out) == 128
+        # deterministic + length-dependent (len_in_bytes feeds b_0)
+        assert out[:32] != expand_message_xmd(b"msg", b"DST", 32)
+        assert out == expand_message_xmd(b"msg", b"DST", 128)
+        assert out != expand_message_xmd(b"msg2", b"DST", 128)
+        assert out != expand_message_xmd(b"msg", b"DST2", 128)
+
+    def test_hash_to_field(self):
+        els = hash_to_field_fp2(b"abc", DEFAULT_DST_G2, 2)
+        assert len(els) == 2 and els[0] != els[1]
+
+    def test_map_to_curve_on_curve(self):
+        for i in range(4):
+            u = _rand_fp2(i + 50)
+            p = map_to_curve_g2(u)
+            assert p.is_on_curve()
+
+    def test_hash_to_g2_valid_and_deterministic(self):
+        q = hash_to_g2(b"round 1 message")
+        assert q.is_on_curve() and q.in_subgroup() and not q.is_infinity()
+        assert q == hash_to_g2(b"round 1 message")
+        assert q != hash_to_g2(b"round 2 message")
+
+    def test_dst_separation(self):
+        assert hash_to_g2(b"m", b"DST-A") != hash_to_g2(b"m", b"DST-B")
+
+    def test_rfc9380_conformance(self):
+        """The selected isogeny must reproduce the RFC 9380 J.10.1 vector —
+        guaranteeing interop with blst/kyber/real drand chains."""
+        from drand_tpu.crypto import hash_to_curve as h
+
+        assert h.RFC_CONFORMANT
+        p = hash_to_g2(b"", h._RFC_J10_1_DST)
+        px, py = p.to_affine()
+        assert px == h._RFC_J10_1_PX and py == h._RFC_J10_1_PY
+
+
+class TestPairingCanonical:
+    def test_canonical_vs_cubed(self):
+        g1, g2 = PointG1.generator(), PointG2.generator()
+        f = miller_loop([(g1, g2)])
+        canon = final_exponentiation(f, canonical=True)
+        cubed = final_exponentiation(f, canonical=False)
+        assert canon.pow(3) == cubed
+        assert canon == final_exponentiation_slow(f, canonical=True)
