@@ -1,0 +1,102 @@
+"""Partial-signature cache with DoS bounds.
+
+Reference: chain/beacon/cache.go — rounds keyed by (round, previousSig);
+at most MAX_PARTIALS_PER_NODE cache entries per node index, evicting the
+oldest when exceeded (chain/beacon/constants.go:14).
+"""
+
+from __future__ import annotations
+
+from ...crypto import tbls
+from ...net.packets import PartialBeaconPacket
+from .. import beacon as chain_beacon
+
+MAX_PARTIALS_PER_NODE = 100
+
+
+def round_id(round_no: int, previous_sig: bytes) -> bytes:
+    return round_no.to_bytes(8, "big") + previous_sig
+
+
+class RoundCache:
+    def __init__(self, rid: bytes, p: PartialBeaconPacket):
+        self.round = p.round
+        self.prev = p.previous_sig
+        self.id = rid
+        self.sigs: dict[int, bytes] = {}
+        self.sigs_v2: dict[int, bytes] = {}
+
+    def append(self, p: PartialBeaconPacket) -> bool:
+        idx = tbls.index_of(p.partial_sig)
+        if idx in self.sigs:
+            return False
+        self.sigs[idx] = p.partial_sig
+        if p.partial_sig_v2:
+            self.sigs_v2[idx] = p.partial_sig_v2
+        return True
+
+    def __len__(self) -> int:
+        return len(self.sigs)
+
+    def len_v2(self) -> int:
+        return len(self.sigs_v2)
+
+    def msg(self) -> bytes:
+        return chain_beacon.message(self.round, self.prev)
+
+    def partials(self) -> list[bytes]:
+        return list(self.sigs.values())
+
+    def partials_v2(self) -> list[bytes]:
+        return list(self.sigs_v2.values())
+
+    def flush_index(self, idx: int) -> None:
+        self.sigs.pop(idx, None)
+        self.sigs_v2.pop(idx, None)
+
+
+class PartialCache:
+    def __init__(self):
+        self.rounds: dict[bytes, RoundCache] = {}
+        self.rcvd: dict[int, list[bytes]] = {}
+
+    def append(self, p: PartialBeaconPacket) -> None:
+        rid = round_id(p.round, p.previous_sig)
+        idx = tbls.index_of(p.partial_sig)
+        rc = self._get_cache(rid, p, idx)
+        if rc is None:
+            return
+        if rc.append(p):
+            self.rcvd.setdefault(idx, []).append(rid)
+
+    def _get_cache(self, rid: bytes, p: PartialBeaconPacket, idx: int) -> RoundCache | None:
+        if rid in self.rounds:
+            return self.rounds[rid]
+        if len(self.rcvd.get(idx, [])) >= MAX_PARTIALS_PER_NODE:
+            # evict this node's oldest entry (the caller's append() records
+            # the new id, keeping the per-node bound exact)
+            to_evict = self.rcvd[idx][0]
+            old = self.rounds.get(to_evict)
+            if old is None:
+                return None
+            old.flush_index(idx)
+            self.rcvd[idx] = self.rcvd[idx][1:]
+            if len(old) == 0:
+                del self.rounds[to_evict]
+        rc = RoundCache(rid, p)
+        self.rounds[rid] = rc
+        return rc
+
+    def get_round_cache(self, round_no: int, previous_sig: bytes) -> RoundCache | None:
+        return self.rounds.get(round_id(round_no, previous_sig))
+
+    def flush_rounds(self, round_no: int) -> None:
+        """Delete every cached round <= round_no and its rcvd counters."""
+        for rid in [r for r, c in self.rounds.items() if c.round <= round_no]:
+            cache = self.rounds.pop(rid)
+            for idx in cache.sigs:
+                remaining = [i for i in self.rcvd.get(idx, []) if i != rid]
+                if remaining:
+                    self.rcvd[idx] = remaining
+                else:
+                    self.rcvd.pop(idx, None)

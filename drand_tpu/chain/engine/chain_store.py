@@ -1,0 +1,164 @@
+"""The aggregation hot loop: consume verified partials, Lagrange-recover the
+full signature (dual V1+V2), verify, append, fan out.
+
+Reference: chain/beacon/chain.go (chainStore :22, runAggregator :91,
+tryAppend :192, RunSync :222). The recover/verify calls route through the
+batched engine when one is configured (the TPU path), else the host tbls.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time as _time
+from dataclasses import dataclass
+
+from ...crypto import tbls
+from ...net.packets import PartialBeaconPacket
+from ...net.transport import ProtocolClient
+from ...utils.logging import KVLogger
+from .. import beacon as chain_beacon
+from .. import time_math
+from ..beacon import Beacon
+from ..store import AppendStore, CallbackStore, Store, StoreError
+from .cache import PartialCache
+from .crypto import CryptoStore
+from .sync import Syncer
+from .ticker import Ticker
+
+# partials accepted up to this many rounds past the last stored beacon
+# (chain/beacon/chain.go:87 partialCacheStoreLimit)
+PARTIAL_CACHE_STORE_LIMIT = 3
+
+
+@dataclass
+class _PartialInfo:
+    addr: str
+    p: PartialBeaconPacket
+
+
+class ChainStore(CallbackStore):
+    """CallbackStore + aggregator task + syncer (chainStore analogue)."""
+
+    def __init__(self, logger: KVLogger, conf, client: ProtocolClient,
+                 crypto: CryptoStore, store: Store, ticker: Ticker):
+        base = AppendStore(store)
+        super().__init__(base)
+        self._l = logger
+        self._conf = conf
+        self._client = client
+        self._crypto = crypto
+        self._ticker = ticker
+        self.sync = Syncer(logger.named("sync"), self, crypto.chain_info, client)
+        # single merged event queue: ("stored", Beacon) | ("partial", _PartialInfo)
+        # — one consumer, no multi-queue cancellation races
+        self._events: asyncio.Queue[tuple[str, object]] = asyncio.Queue(maxsize=512)
+        # notifies the Handler when a beacon was aggregated without sync
+        self.catchup_beacons: asyncio.Queue[Beacon] = asyncio.Queue(maxsize=1)
+        self._agg_task: asyncio.Task | None = None
+        self.add_callback("chainstore", self._on_stored)
+
+    def start(self) -> None:
+        self._agg_task = asyncio.ensure_future(self._run_aggregator())
+
+    def stop(self) -> None:
+        if self._agg_task is not None:
+            self._agg_task.cancel()
+
+    def _on_stored(self, b: Beacon) -> None:
+        try:
+            self._events.put_nowait(("stored", b))
+        except asyncio.QueueFull:
+            pass
+
+    def new_valid_partial(self, addr: str, p: PartialBeaconPacket) -> None:
+        try:
+            self._events.put_nowait(("partial", _PartialInfo(addr, p)))
+        except asyncio.QueueFull:
+            self._l.warn("aggregator", "partial_queue_full", dropping=p.round)
+
+    async def _run_aggregator(self) -> None:
+        last = self.last()
+        cache = PartialCache()
+        while True:
+            kind, payload = await self._events.get()
+            if kind == "stored":
+                last = payload
+                cache.flush_rounds(last.round)
+                continue
+            partial = payload
+            p_round = partial.p.round
+            if not (last.round < p_round <= last.round + PARTIAL_CACHE_STORE_LIMIT + 1):
+                self._l.debug("aggregator", "ignoring_partial", round=p_round,
+                              last=last.round)
+                continue
+            group = self._crypto.get_group()
+            thr, n = group.threshold, len(group)
+            cache.append(partial.p)
+            rc = cache.get_round_cache(p_round, partial.p.previous_sig)
+            if rc is None:
+                self._l.error("aggregator", "no_round_cache", round=p_round)
+                continue
+            self._l.debug("aggregator", "store_partial", addr=partial.addr,
+                          round=rc.round, have=f"{len(rc)}/{thr}")
+            if len(rc) < thr:
+                continue
+            new_beacon = self._aggregate(rc, thr, n)
+            if new_beacon is None:
+                continue
+            cache.flush_rounds(rc.round)
+            self._l.info("aggregator", "aggregated_beacon", round=new_beacon.round,
+                         v2=new_beacon.is_v2())
+            if self._try_append(last, new_beacon):
+                last = new_beacon
+                continue
+            if new_beacon.round > last.round + 1:
+                # aggregated a beacon ahead of our chain: catch up
+                peers = [nd.identity for nd in group.nodes]
+                asyncio.ensure_future(self.sync.follow(new_beacon.round, peers))
+
+    def _aggregate(self, rc, thr: int, n: int) -> Beacon | None:
+        """Recover + verify V1 and (when possible) V2 — the crypto hot path
+        (chain/beacon/chain.go:136-166)."""
+        pub = self._crypto.get_pub()
+        msg = rc.msg()
+        try:
+            final_sig = tbls.recover(pub, msg, rc.partials(), thr, n)
+        except ValueError as e:
+            self._l.debug("aggregator", "invalid_recovery", err=str(e), round=rc.round)
+            return None
+        if not tbls.verify_recovered(pub.commit(), msg, final_sig):
+            self._l.error("aggregator", "invalid_sig", round=rc.round)
+            return None
+        b = Beacon(round=rc.round, previous_sig=rc.prev, signature=final_sig)
+        if rc.len_v2() >= thr:
+            msg_v2 = chain_beacon.message_v2(rc.round)
+            try:
+                sig_v2 = tbls.recover(pub, msg_v2, rc.partials_v2(), thr, n)
+            except ValueError as e:
+                self._l.debug("aggregator", "invalid_recovery_v2", err=str(e))
+                return None  # never accept a beacon whose V2 fails to recover
+            if tbls.verify_recovered(pub.commit(), msg_v2, sig_v2):
+                b.signature_v2 = sig_v2
+            else:
+                self._l.error("aggregator", "invalid_sig_v2", round=rc.round)
+                return None
+        return b
+
+    def _try_append(self, last: Beacon, new_beacon: Beacon) -> bool:
+        if last.round + 1 != new_beacon.round:
+            return False
+        try:
+            self.put(new_beacon)
+        except StoreError as e:
+            self._l.error("aggregator", "error_storing", err=str(e))
+            return False
+        try:
+            self.catchup_beacons.put_nowait(new_beacon)
+        except asyncio.QueueFull:
+            pass
+        return True
+
+    async def run_sync(self, up_to: int, peers: list | None) -> None:
+        if peers is None:
+            peers = [nd.identity for nd in self._crypto.get_group().nodes]
+        await self.sync.follow(up_to, peers)

@@ -1,0 +1,49 @@
+"""Thread-safe holder of the node's share/group — swapped atomically at
+reshare transitions (reference: chain/beacon/crypto.go).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ...crypto import tbls
+from ...crypto.poly import PubPoly
+from ...key.group import Group
+from ...key.keys import Share
+from ..info import Info
+
+
+class CryptoStore:
+    def __init__(self, group: Group, share: Share):
+        self._lock = threading.Lock()
+        self._group = group
+        self._share = share
+        self._pub_poly = share.pub_poly()  # one instance: eval cache persists
+        self.chain_info = Info.from_group(group)
+
+    def get_group(self) -> Group:
+        with self._lock:
+            return self._group
+
+    def get_pub(self) -> PubPoly:
+        with self._lock:
+            return self._pub_poly
+
+    def index(self) -> int:
+        with self._lock:
+            return self._share.pri_share.index
+
+    def sign_partial(self, msg: bytes) -> bytes:
+        """Partial tbls signature with this node's share
+        (chain/beacon/crypto.go:55). Host-CPU signing keeps the secret share
+        off the accelerator (SURVEY.md §7 side-channel posture)."""
+        with self._lock:
+            share = self._share.pri_share
+        return tbls.sign_partial(share, msg)
+
+    def set_info(self, group: Group, share: Share) -> None:
+        """Atomic swap at reshare transition (crypto.go:66)."""
+        with self._lock:
+            self._group = group
+            self._share = share
+            self._pub_poly = share.pub_poly()

@@ -1,0 +1,257 @@
+"""Per-node beacon protocol driver: the round loop.
+
+Reference: chain/beacon/node.go (Handler :36). Each period tick: sign the
+next round's V1+V2 messages with the node's share, feed the local aggregator
+and broadcast to all peers; fast-path catchup when the chain lags.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import AsyncIterator
+
+from ...crypto import tbls
+from ...key.group import Group
+from ...key.keys import Node, Share
+from ...net.packets import PartialBeaconPacket, SyncRequest
+from ...net.transport import ProtocolClient, ProtocolService, TransportError
+from ...utils.clock import Clock
+from ...utils.logging import KVLogger
+from .. import beacon as chain_beacon
+from .. import time_math
+from ..beacon import Beacon
+from ..store import Store, genesis_beacon
+from .chain_store import ChainStore
+from .crypto import CryptoStore
+from .ticker import Ticker
+
+
+@dataclass
+class BeaconConfig:
+    """chain/beacon/node.go:23 Config analogue."""
+
+    public: Node
+    share: Share
+    group: Group
+    clock: Clock
+
+
+class Handler(ProtocolService):
+    def __init__(self, client: ProtocolClient, store: Store, conf: BeaconConfig,
+                 logger: KVLogger):
+        if conf.group.find(conf.public.identity) is None:
+            raise ValueError("beacon: keypair not included in the given group")
+        self.conf = conf
+        self.addr = conf.public.address()
+        self._l = logger
+        self.crypto = CryptoStore(conf.group, conf.share)
+        store.put(genesis_beacon(self.crypto.chain_info))
+        self.ticker = Ticker(conf.clock, conf.group.period, conf.group.genesis_time)
+        self.chain = ChainStore(logger.named("chain"), conf, client, self.crypto,
+                                store, self.ticker)
+        self._client = client
+        self._run_task: asyncio.Task | None = None
+        self._stopped = False
+        self._current_round = 0
+
+    # ------------------------------------------------------------------ API
+    async def start(self) -> None:
+        """Fresh network: genesis must be in the future (node.go:164)."""
+        if self.conf.clock.now() > self.conf.group.genesis_time:
+            raise RuntimeError("beacon: genesis time already passed. Call catchup()")
+        _, ttime = time_math.next_round(
+            int(self.conf.clock.now()), self.conf.group.period,
+            self.conf.group.genesis_time)
+        self._l.info("beacon", "start")
+        self._launch(ttime)
+
+    async def catchup(self) -> None:
+        """Rejoin a running network: sync then participate (node.go:180)."""
+        n_round, ttime = time_math.next_round(
+            int(self.conf.clock.now()), self.conf.group.period,
+            self.conf.group.genesis_time)
+        self._launch(ttime)
+        asyncio.ensure_future(self.chain.run_sync(n_round, None))
+
+    async def transition(self, prev_group: Group) -> None:
+        """New node joining at a reshare: sync the old chain up to the
+        transition round, start at transition time (node.go:190)."""
+        target_time = self.conf.group.transition_time
+        t_round = time_math.current_round(target_time, self.conf.group.period,
+                                          self.conf.group.genesis_time)
+        t_time = time_math.time_of_round(self.conf.group.period,
+                                         self.conf.group.genesis_time, t_round)
+        if t_time != target_time:
+            raise ValueError(f"transition time {target_time} not a round boundary")
+        self._launch(target_time)
+        peers = [nd.identity for nd in prev_group.nodes]
+        asyncio.ensure_future(self.chain.run_sync(t_round - 1, peers))
+
+    def transition_new_group(self, new_share: Share, new_group: Group) -> None:
+        """Existing member: swap share exactly after round T-1 is stored
+        (node.go:206)."""
+        target_time = new_group.transition_time
+        t_round = time_math.current_round(target_time, self.conf.group.period,
+                                          self.conf.group.genesis_time)
+        target_round = t_round - 1
+        self._l.debug("transition", "new_group", at_round=t_round)
+
+        def _cb(b: Beacon) -> None:
+            if b.round < target_round:
+                return
+            self.crypto.set_info(new_group, new_share)
+            self.chain.remove_callback("transition")
+
+        self.chain.add_callback("transition", _cb)
+
+    def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        if self._run_task is not None:
+            self._run_task.cancel()
+        self.chain.stop()
+        self.ticker.stop()
+        self._l.info("beacon", "stop")
+
+    async def stop_at(self, stop_time: int) -> None:
+        now = self.conf.clock.now()
+        if stop_time <= now:
+            raise ValueError("can't stop in the past or present")
+        await self.conf.clock.sleep(stop_time - now)
+        self.stop()
+
+    # ------------------------------------------------------- service surface
+    async def process_partial_beacon(self, from_addr: str,
+                                     p: PartialBeaconPacket) -> None:
+        """Partial ingress: clock-window check, verify both partial sigs,
+        hand to the aggregator (node.go:92-160)."""
+        next_round, _ = time_math.next_round(
+            int(self.conf.clock.now()), self.conf.group.period,
+            self.conf.group.genesis_time)
+        current_round = next_round - 1
+        # allow one round in the future for clock drift
+        if p.round > next_round:
+            self._l.error("process_partial", from_addr, invalid_future_round=p.round,
+                          current_round=current_round)
+            raise TransportError(
+                f"invalid round: {p.round} instead of {current_round}")
+        msg = chain_beacon.message(p.round, p.previous_sig)
+        pub = self.crypto.get_pub()
+        if not tbls.verify_partial(pub, msg, p.partial_sig):
+            self._l.error("process_partial", from_addr, err="invalid partial sig",
+                          round=p.round)
+            raise TransportError("invalid partial signature")
+        if p.partial_sig_v2:
+            # both partials must come from the same share index: otherwise a
+            # malicious member can pair its own V1 partial with a replayed
+            # honest V2 partial, inflating the V2 count with duplicate
+            # embedded indices and vetoing rounds (reference node.go:121-130
+            # lacks this check — fixed here).
+            if tbls.index_of(p.partial_sig_v2) != tbls.index_of(p.partial_sig):
+                self._l.error("process_partial_v2", from_addr,
+                              err="v1/v2 index mismatch", round=p.round)
+                raise TransportError("partial signature index mismatch")
+            msg_v2 = chain_beacon.message_v2(p.round)
+            if not tbls.verify_partial(pub, msg_v2, p.partial_sig_v2):
+                self._l.error("process_partial_v2", from_addr,
+                              err="invalid partial sig v2", round=p.round)
+                raise TransportError("invalid partial signature v2")
+        if tbls.index_of(p.partial_sig) == self.crypto.index():
+            # a reflected copy of our own partial: ignore
+            return
+        self.chain.new_valid_partial(from_addr, p)
+
+    def sync_chain(self, from_addr: str, req: SyncRequest) -> AsyncIterator[Beacon]:
+        return self.chain.sync.sync_chain(from_addr, req)
+
+    async def chain_info(self, from_addr: str):
+        return self.crypto.chain_info
+
+    # ------------------------------------------------------------ round loop
+    def _launch(self, start_time: int) -> None:
+        self.ticker.start()
+        self.chain.start()
+        self._run_task = asyncio.ensure_future(self._run(start_time))
+
+    async def _run(self, start_time: int) -> None:
+        chan = self.ticker.channel_at(start_time)
+        self._l.debug("run_round", wait_until=start_time)
+        # merge ticker + catchup notifications into one event queue
+        events: asyncio.Queue[tuple[str, object]] = asyncio.Queue()
+
+        async def _pump(src: asyncio.Queue, tag: str) -> None:
+            while True:
+                item = await src.get()
+                await events.put((tag, item))
+
+        pumps = [
+            asyncio.ensure_future(_pump(chan, "tick")),
+            asyncio.ensure_future(_pump(self.chain.catchup_beacons, "catchup")),
+        ]
+        try:
+            while True:
+                kind, payload = await events.get()
+                if kind == "tick":
+                    current = payload
+                    self._current_round = current.round
+                    last = self.chain.last()
+                    self._l.debug("beacon_loop", new_round=current.round,
+                                  last_beacon=last.round)
+                    await self._broadcast_next_partial(current.round, last)
+                    if last.round + 1 < current.round:
+                        # chain halted for a gap: sync with the group
+                        self._l.debug("beacon_loop", run_sync_catchup=current.round)
+                        asyncio.ensure_future(
+                            self.chain.run_sync(current.round, None))
+                else:
+                    b = payload
+                    if b.round < self._current_round:
+                        # network recovering: hurry the next beacon after a
+                        # catchup-period breather (node.go:256-271)
+                        asyncio.ensure_future(self._delayed_broadcast(b))
+        except asyncio.CancelledError:
+            self._l.debug("beacon_loop", "finished")
+        finally:
+            for p in pumps:
+                p.cancel()
+
+    async def _delayed_broadcast(self, upon: Beacon) -> None:
+        await self.conf.clock.sleep(self.conf.group.catchup_period)
+        if not self._stopped:
+            await self._broadcast_next_partial(self._current_round, upon)
+
+    async def _broadcast_next_partial(self, current_round: int, upon: Beacon) -> None:
+        previous_sig = upon.signature
+        round_no = upon.round + 1
+        if current_round == upon.round:
+            # we already have this round's beacon: re-broadcast it per spec
+            previous_sig = upon.previous_sig
+            round_no = current_round
+        msg = chain_beacon.message(round_no, previous_sig)
+        curr_sig = self.crypto.sign_partial(msg)
+        sig_v2 = self.crypto.sign_partial(chain_beacon.message_v2(round_no))
+        packet = PartialBeaconPacket(
+            round=round_no,
+            previous_sig=previous_sig,
+            partial_sig=curr_sig,
+            partial_sig_v2=sig_v2,
+        )
+        self._l.debug("broadcast_partial", round=round_no)
+        self.chain.new_valid_partial(self.addr, packet)
+        for node in self.crypto.get_group().nodes:
+            if node.address() == self.addr:
+                continue
+            asyncio.ensure_future(self._send_partial(node, packet))
+
+    async def _send_partial(self, node, packet: PartialBeaconPacket) -> None:
+        try:
+            await self._client.partial_beacon(node.identity, packet)
+        except TransportError as e:
+            self._l.debug("beacon_round", packet.round, err_request=str(e),
+                          to=node.address())
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # peer-side verification errors etc.
+            self._l.debug("beacon_round", packet.round, err=str(e), to=node.address())

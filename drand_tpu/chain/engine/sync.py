@@ -1,0 +1,125 @@
+"""Chain synchronization: follow peers' chains (client) and serve sync
+streams (server). Reference: chain/beacon/sync.go.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import AsyncIterator
+
+from ...net.packets import SyncRequest
+from ...net.transport import ProtocolClient, TransportError
+from ...utils.logging import KVLogger
+from .. import beacon as chain_beacon
+from ..beacon import Beacon
+from ..info import Info
+from ..store import CallbackStore, StoreError
+
+
+class Syncer:
+    """Client side: Follow shuffles peers and streams beacons from last+1,
+    verifying each link. Server side: SyncChain replays the cursor then
+    streams live beacons via a store callback."""
+
+    def __init__(self, logger: KVLogger, store: CallbackStore, info: Info,
+                 client: ProtocolClient):
+        self._l = logger
+        self._store = store
+        self._info = info
+        self._client = client
+        self._following = False
+        self._lock = asyncio.Lock()
+
+    def syncing(self) -> bool:
+        return self._following
+
+    async def follow(self, up_to: int, peers: list) -> bool:
+        """Blocking: fetch/verify/store beacons until up_to (0 = forever).
+        Returns True if the target round was reached."""
+        async with self._lock:
+            if self._following:
+                self._l.debug("syncer", "already_following")
+                return False
+            self._following = True
+        try:
+            order = list(peers)
+            random.shuffle(order)
+            for peer in order:
+                if await self._try_node(up_to, peer):
+                    return True
+            self._l.debug("syncer", "tried_all_nodes")
+            return False
+        finally:
+            self._following = False
+
+    async def _try_node(self, up_to: int, peer) -> bool:
+        try:
+            last = self._store.last()
+        except StoreError:
+            return False
+        try:
+            stream = self._client.sync_chain(peer, SyncRequest(from_round=last.round + 1))
+            async for b in stream:
+                if not chain_beacon.verify_beacon(self._info.public_key, b):
+                    self._l.warn("syncer", "invalid_beacon", peer=_addr(peer), round=b.round)
+                    return False
+                # V2 must also verify when present: a malicious sync peer must
+                # not be able to poison the unchained signature (the timelock
+                # decryption key). The reference omits this (sync.go:105) —
+                # fixed here.
+                if b.is_v2() and not chain_beacon.verify_beacon_v2(self._info.public_key, b):
+                    self._l.warn("syncer", "invalid_beacon_v2", peer=_addr(peer), round=b.round)
+                    return False
+                try:
+                    self._store.put(b)
+                except StoreError as e:
+                    self._l.debug("syncer", "store_failed", err=str(e))
+                    return False
+                last = b
+                if up_to and last.round >= up_to:
+                    self._l.debug("syncer", "finished", round=up_to)
+                    return True
+        except TransportError as e:
+            self._l.debug("syncer", "unable_to_sync", peer=_addr(peer), err=str(e))
+            return False
+        except asyncio.CancelledError:
+            raise
+        return False
+
+    async def sync_chain(self, from_addr: str, req: SyncRequest) -> AsyncIterator[Beacon]:
+        """Server side: replay from the cursor, then live-stream."""
+        try:
+            last = self._store.last()
+        except StoreError:
+            return
+        if last.round < req.from_round:
+            raise TransportError(
+                f"no beacon stored above requested round {last.round} < {req.from_round}"
+            )
+        queue: asyncio.Queue[Beacon] = asyncio.Queue(maxsize=256)
+        cb_id = f"sync-{from_addr}-{id(queue)}"
+
+        def _on_beacon(b: Beacon) -> None:
+            try:
+                queue.put_nowait(b)
+            except asyncio.QueueFull:
+                pass  # slow consumer: it will re-sync
+
+        self._store.add_callback(cb_id, _on_beacon)
+        try:
+            sent = 0
+            for b in self._store.cursor_from(req.from_round):
+                yield b
+                sent = b.round
+            while True:
+                b = await queue.get()
+                if b.round > sent:
+                    yield b
+                    sent = b.round
+        finally:
+            self._store.remove_callback(cb_id)
+
+
+def _addr(peer) -> str:
+    return peer.address() if hasattr(peer, "address") else str(peer)

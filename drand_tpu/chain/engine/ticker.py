@@ -1,0 +1,81 @@
+"""Genesis-aligned period ticker (reference: chain/beacon/ticker.go).
+
+Fans out (round, time) to subscriber queues each period; subscribers with a
+future start time don't receive ticks until it passes. Mock-clock friendly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+
+from .. import time_math
+from ...utils.clock import Clock
+
+
+@dataclass(frozen=True)
+class RoundInfo:
+    round: int
+    time: int
+
+
+class Ticker:
+    def __init__(self, clock: Clock, period: int, genesis: int):
+        self._clock = clock
+        self._period = period
+        self._genesis = genesis
+        self._channels: list[tuple[asyncio.Queue, int]] = []
+        self._task: asyncio.Task | None = None
+        self._stopped = False
+
+    def start(self) -> None:
+        self._task = asyncio.ensure_future(self._run())
+
+    def channel_at(self, start_at: int) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue(maxsize=1)
+        self._channels.append((q, start_at))
+        return q
+
+    def channel(self) -> asyncio.Queue:
+        return self.channel_at(int(self._clock.now()))
+
+    def current_round(self) -> int:
+        return time_math.current_round(int(self._clock.now()), self._period, self._genesis)
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._task is not None:
+            self._task.cancel()
+
+    async def _run(self) -> None:
+        try:
+            # sleep until the next round boundary, then tick every period.
+            # If we start exactly on a boundary (e.g. woken late at genesis),
+            # emit that round's tick immediately instead of skipping it.
+            now = int(self._clock.now())
+            on_boundary = (
+                now >= self._genesis and (now - self._genesis) % self._period == 0
+            )
+            if not on_boundary:
+                _, ttime = time_math.next_round(now, self._period, self._genesis)
+                if ttime > now:
+                    await self._clock.sleep(ttime - now)
+            while not self._stopped:
+                now = int(self._clock.now())
+                info = RoundInfo(
+                    round=time_math.current_round(now, self._period, self._genesis),
+                    time=now,
+                )
+                for q, start_at in self._channels:
+                    if start_at > info.time:
+                        continue
+                    try:
+                        q.put_nowait(info)
+                    except asyncio.QueueFull:
+                        pass  # slow consumer: drop, like the reference
+                # sleep to the next boundary (not a fixed period: stay aligned)
+                _, ttime = time_math.next_round(int(self._clock.now()), self._period, self._genesis)
+                delta = ttime - self._clock.now()
+                await self._clock.sleep(max(delta, 0.001))
+        except asyncio.CancelledError:
+            pass

@@ -1,0 +1,76 @@
+"""Public chain descriptor (reference: chain/info.go:16-50).
+
+Everything a client needs to verify the chain: collective key, period,
+genesis time, and the pinned hashes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass
+
+from ..crypto.curves import PointG1
+
+
+@dataclass
+class Info:
+    public_key: PointG1
+    period: int
+    genesis_time: int
+    genesis_seed: bytes
+    group_hash: bytes = b""
+
+    def hash(self) -> bytes:
+        """Canonical chain hash (chain/info.go:36): clients pin this."""
+        h = hashlib.sha256()
+        h.update(self.period.to_bytes(4, "big"))
+        h.update(int(self.genesis_time).to_bytes(8, "big", signed=True))
+        h.update(self.public_key.to_bytes())
+        h.update(self.group_hash)
+        return h.digest()
+
+    def equal(self, other: "Info") -> bool:
+        return (
+            self.public_key == other.public_key
+            and self.period == other.period
+            and self.genesis_time == other.genesis_time
+            and self.genesis_seed == other.genesis_seed
+        )
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "public_key": self.public_key.to_bytes().hex(),
+                "period": self.period,
+                "genesis_time": self.genesis_time,
+                "genesis_seed": self.genesis_seed.hex(),
+                "group_hash": self.group_hash.hex(),
+                "hash": self.hash().hex(),
+            },
+            sort_keys=True,
+        )
+
+    @staticmethod
+    def from_json(data: str | bytes) -> "Info":
+        d = json.loads(data)
+        return Info(
+            public_key=PointG1.from_bytes(bytes.fromhex(d["public_key"])),
+            period=d["period"],
+            genesis_time=d["genesis_time"],
+            genesis_seed=bytes.fromhex(d["genesis_seed"]),
+            group_hash=bytes.fromhex(d.get("group_hash", "")),
+        )
+
+    @staticmethod
+    def from_group(group) -> "Info":
+        """chain.NewChainInfo analogue."""
+        if group.public_key is None:
+            raise ValueError("group has no distributed public key")
+        return Info(
+            public_key=group.public_key.key(),
+            period=group.period,
+            genesis_time=group.genesis_time,
+            genesis_seed=group.get_genesis_seed(),
+            group_hash=group.hash(),
+        )

@@ -1,0 +1,285 @@
+"""Beacon chain storage: interface, in-memory and SQLite backends, and the
+append/callback decorators the beacon engine stacks on top.
+
+Reference: chain/store.go (Store/Cursor/GenesisBeacon), chain/boltdb/store.go
+(durable KV store, 8-byte BE round keys), chain/beacon/store.go (appendStore
+monotonicity :26, callbackStore fan-out :85).
+
+The SQLite backend replaces bbolt: single-writer append workload, read-mostly
+serving — same niche, stdlib-available.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sqlite3
+import threading
+from typing import Callable, Iterator
+
+from .beacon import Beacon
+from .info import Info
+
+
+class StoreError(Exception):
+    pass
+
+
+class Store:
+    """Append-oriented beacon store (reference chain/store.go:14)."""
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def put(self, b: Beacon) -> None:
+        raise NotImplementedError
+
+    def last(self) -> Beacon:
+        raise NotImplementedError
+
+    def get(self, round_no: int) -> Beacon | None:
+        raise NotImplementedError
+
+    def cursor(self) -> Iterator[Beacon]:
+        """Iterate beacons in round order."""
+        raise NotImplementedError
+
+    def cursor_from(self, from_round: int) -> Iterator[Beacon]:
+        raise NotImplementedError
+
+    def del_round(self, round_no: int) -> None:
+        """Rollback support (`drand util del-beacon`, cli.go:651)."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+def genesis_beacon(info: Info) -> Beacon:
+    """Round 0: fixed, signature = genesis seed (chain/store.go:47)."""
+    return Beacon(round=0, previous_sig=b"", signature=info.genesis_seed)
+
+
+class MemStore(Store):
+    """Dict-backed store for tests and relays."""
+
+    def __init__(self):
+        self._by_round: dict[int, Beacon] = {}
+        self._last: Beacon | None = None
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._by_round)
+
+    def put(self, b: Beacon) -> None:
+        with self._lock:
+            self._by_round[b.round] = b
+            if self._last is None or b.round >= self._last.round:
+                self._last = b
+
+    def last(self) -> Beacon:
+        with self._lock:
+            if self._last is None:
+                raise StoreError("store is empty")
+            return self._last
+
+    def get(self, round_no: int) -> Beacon | None:
+        with self._lock:
+            return self._by_round.get(round_no)
+
+    def cursor(self) -> Iterator[Beacon]:
+        with self._lock:
+            rounds = sorted(self._by_round)
+            items = [self._by_round[r] for r in rounds]
+        yield from items
+
+    def cursor_from(self, from_round: int) -> Iterator[Beacon]:
+        for b in self.cursor():
+            if b.round >= from_round:
+                yield b
+
+    def del_round(self, round_no: int) -> None:
+        with self._lock:
+            self._by_round.pop(round_no, None)
+            if self._last is not None and self._last.round == round_no:
+                self._last = (
+                    self._by_round[max(self._by_round)] if self._by_round else None
+                )
+
+
+class SQLiteStore(Store):
+    """Durable chain store (boltdb replacement). Key = round, value =
+    hex-JSON beacon, mirroring chain/boltdb/store.go:21-85."""
+
+    def __init__(self, path: str):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS beacons ("
+            " round INTEGER PRIMARY KEY,"
+            " data BLOB NOT NULL)"
+        )
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA synchronous=NORMAL")
+        self._conn.commit()
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            (n,) = self._conn.execute("SELECT COUNT(*) FROM beacons").fetchone()
+        return n
+
+    def put(self, b: Beacon) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO beacons (round, data) VALUES (?, ?)",
+                (b.round, b.marshal()),
+            )
+            self._conn.commit()
+
+    def last(self) -> Beacon:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT data FROM beacons ORDER BY round DESC LIMIT 1"
+            ).fetchone()
+        if row is None:
+            raise StoreError("store is empty")
+        return Beacon.unmarshal(row[0])
+
+    def get(self, round_no: int) -> Beacon | None:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT data FROM beacons WHERE round = ?", (round_no,)
+            ).fetchone()
+        return None if row is None else Beacon.unmarshal(row[0])
+
+    def cursor(self) -> Iterator[Beacon]:
+        return self.cursor_from(0)
+
+    def cursor_from(self, from_round: int, batch: int = 512) -> Iterator[Beacon]:
+        """Streams in batches: a sync of a multi-million-round chain must not
+        materialize it in memory or hold the lock for the whole walk."""
+        next_round = from_round
+        while True:
+            with self._lock:
+                rows = self._conn.execute(
+                    "SELECT round, data FROM beacons WHERE round >= ?"
+                    " ORDER BY round LIMIT ?",
+                    (next_round, batch),
+                ).fetchall()
+            if not rows:
+                return
+            for r, data in rows:
+                yield Beacon.unmarshal(data)
+            next_round = rows[-1][0] + 1
+
+    def del_round(self, round_no: int) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM beacons WHERE round = ?", (round_no,))
+            self._conn.commit()
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+class AppendStore(Store):
+    """Monotonicity guard: only round+1 with matching previous signature
+    (chain/beacon/store.go:26-53)."""
+
+    def __init__(self, inner: Store):
+        self._inner = inner
+        self._lock = threading.Lock()
+        try:
+            self._last: Beacon | None = inner.last()
+        except StoreError:
+            self._last = None
+
+    def put(self, b: Beacon) -> None:
+        with self._lock:
+            if self._last is not None:
+                if b.round != self._last.round + 1:
+                    raise StoreError(
+                        f"invalid round inserted: last {self._last.round}, new {b.round}"
+                    )
+                if self._last.signature != b.previous_sig:
+                    raise StoreError("invalid previous signature")
+            self._inner.put(b)
+            self._last = b
+
+    # delegate reads
+    def __len__(self):
+        return len(self._inner)
+
+    def last(self):
+        return self._inner.last()
+
+    def get(self, r):
+        return self._inner.get(r)
+
+    def cursor(self):
+        return self._inner.cursor()
+
+    def cursor_from(self, r):
+        return self._inner.cursor_from(r)
+
+    def del_round(self, r):
+        with self._lock:
+            self._inner.del_round(r)
+            try:
+                self._last = self._inner.last()
+            except StoreError:
+                self._last = None
+
+    def close(self):
+        self._inner.close()
+
+
+class CallbackStore(Store):
+    """Fans every stored beacon out to registered callbacks
+    (chain/beacon/store.go:85; worker pool replaced by asyncio tasks).
+    Callbacks may be sync or async; they never run for the genesis round."""
+
+    def __init__(self, inner: Store):
+        self._inner = inner
+        self._callbacks: dict[str, Callable] = {}
+        self._lock = threading.Lock()
+
+    def add_callback(self, cb_id: str, fn: Callable) -> None:
+        with self._lock:
+            self._callbacks[cb_id] = fn
+
+    def remove_callback(self, cb_id: str) -> None:
+        with self._lock:
+            self._callbacks.pop(cb_id, None)
+
+    def put(self, b: Beacon) -> None:
+        self._inner.put(b)
+        if b.round == 0:
+            return
+        with self._lock:
+            cbs = list(self._callbacks.values())
+        for cb in cbs:
+            res = cb(b)
+            if asyncio.iscoroutine(res):
+                asyncio.ensure_future(res)
+
+    def __len__(self):
+        return len(self._inner)
+
+    def last(self):
+        return self._inner.last()
+
+    def get(self, r):
+        return self._inner.get(r)
+
+    def cursor(self):
+        return self._inner.cursor()
+
+    def cursor_from(self, r):
+        return self._inner.cursor_from(r)
+
+    def del_round(self, r):
+        self._inner.del_round(r)
+
+    def close(self):
+        self._inner.close()

@@ -1,0 +1,51 @@
+"""Deterministic round <-> time mapping (reference: chain/time.go:18-65).
+
+Round 0 is the fixed genesis beacon; round 1 happens at genesis time;
+round k at genesis + (k-1)*period. Overflow-guarded like the reference.
+"""
+
+from __future__ import annotations
+
+import math
+
+# reference chain/time.go:8-14: stay below int64 max with headroom
+_TIME_BUFFER_BITS = 36
+_MAX_TIME_BUFFER = 1 << _TIME_BUFFER_BITS
+_MAX_INT64 = (1 << 63) - 1
+_MAX_UINT64 = (1 << 64) - 1
+
+TIME_OF_ROUND_ERROR_VALUE = _MAX_INT64 - _MAX_TIME_BUFFER
+
+
+def time_of_round(period: int, genesis: int, round_no: int) -> int:
+    """Unix time at which `round_no` should be produced."""
+    if round_no == 0:
+        return genesis
+    if period < 0:
+        return TIME_OF_ROUND_ERROR_VALUE
+    period_bits = math.log2(period + 1)
+    if round_no >= (_MAX_UINT64 >> (int(period_bits) + 2)):
+        return TIME_OF_ROUND_ERROR_VALUE
+    delta = (round_no - 1) * period
+    val = genesis + delta
+    if val > _MAX_INT64 - _MAX_TIME_BUFFER:
+        return TIME_OF_ROUND_ERROR_VALUE
+    return val
+
+
+def next_round(now: int, period: int, genesis: int) -> tuple[int, int]:
+    """(next upcoming round, its unix time)."""
+    if now < genesis:
+        return 1, genesis
+    from_genesis = now - genesis
+    next_r = int(from_genesis // period) + 1
+    next_t = genesis + next_r * period
+    return next_r + 1, next_t
+
+
+def current_round(now: int, period: int, genesis: int) -> int:
+    """The round active at `now` (round whose scheduled time has passed)."""
+    next_r, _ = next_round(now, period, genesis)
+    if next_r <= 1:
+        return next_r
+    return next_r - 1

@@ -1,0 +1,53 @@
+"""ECIES over G1 — private-randomness transport and DKG deal encryption.
+
+Mirrors kyber/encrypt/ecies as used by the reference
+(core/drand_public.go:130-148 PrivateRand; deal encryption inside the DKG):
+ephemeral ECDH on G1, HKDF-SHA256 key derivation, AES-256-GCM AEAD.
+
+Ciphertext layout: 48-byte compressed ephemeral G1 point || GCM sealed box.
+"""
+
+from __future__ import annotations
+
+import secrets
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+from cryptography.hazmat.primitives import hashes
+
+from .fields import R
+from .curves import PointG1
+
+_KEY_LEN = 32
+_NONCE_LEN = 12
+EPH_SIZE = PointG1.COMPRESSED_SIZE
+
+
+def _derive(dh: PointG1) -> tuple[bytes, bytes]:
+    okm = HKDF(
+        algorithm=hashes.SHA256(),
+        length=_KEY_LEN + _NONCE_LEN,
+        salt=None,
+        info=b"",
+    ).derive(dh.to_bytes())
+    return okm[:_KEY_LEN], okm[_KEY_LEN:]
+
+
+def encrypt(public: PointG1, msg: bytes) -> bytes:
+    r = secrets.randbelow(R - 1) + 1
+    eph = PointG1.generator().mul(r)
+    key, nonce = _derive(public.mul(r))
+    sealed = AESGCM(key).encrypt(nonce, msg, None)
+    return eph.to_bytes() + sealed
+
+
+def decrypt(sk: int, ciphertext: bytes) -> bytes:
+    """Raises ValueError on any malformed or tampered ciphertext."""
+    if len(ciphertext) < EPH_SIZE + 16:
+        raise ValueError("ciphertext too short")
+    eph = PointG1.from_bytes(ciphertext[:EPH_SIZE])
+    key, nonce = _derive(eph.mul(sk))
+    try:
+        return AESGCM(key).decrypt(nonce, ciphertext[EPH_SIZE:], None)
+    except Exception as e:  # InvalidTag
+        raise ValueError(f"ECIES decryption failed: {e}") from e

@@ -618,6 +618,17 @@ def fr_from_bytes_wide(b: bytes) -> int:
     return int.from_bytes(b, "big") % R
 
 
+def fr_from_seed(domain: bytes, seed: bytes) -> int:
+    """Deterministic NONZERO scalar from a seed: 512-bit SHA-256 widening
+    reduced into [1, r). The single derivation used by seeded keygen and
+    polynomial sampling — keep it in one place."""
+    import hashlib
+
+    h = hashlib.sha256(domain + seed).digest()
+    h2 = hashlib.sha256(h).digest()
+    return (int.from_bytes(h + h2, "big") % (R - 1)) + 1
+
+
 def fr_to_bytes(a: int) -> bytes:
     return int(a % R).to_bytes(FR_BYTES, "big")
 

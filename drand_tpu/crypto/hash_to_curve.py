@@ -21,6 +21,7 @@ on E2, so the map cannot be silently wrong.
 from __future__ import annotations
 
 import hashlib
+from functools import lru_cache
 
 from .fields import P, Fp2, XI, fp_inv
 from .curves import PointG2
@@ -445,9 +446,15 @@ def map_to_curve_g2(u: Fp2) -> PointG2:
     return PointG2.from_affine(X, Y)
 
 
+@lru_cache(maxsize=1024)
 def hash_to_g2(msg: bytes, dst: bytes = DEFAULT_DST_G2) -> PointG2:
     """Full hash_to_curve: uniform, deterministic map into the r-order
-    subgroup of G2. This is H(m) in every signature equation."""
+    subgroup of G2. This is H(m) in every signature equation.
+
+    Memoized: in one beacon round every node hashes the same two messages
+    (V1 and V2) once per sign and once per incoming partial — the protocol
+    hot loop reuses the cached point.
+    """
     u0, u1 = hash_to_field_fp2(msg, dst, 2)
     q = map_to_curve_g2(u0) + map_to_curve_g2(u1)
     return q.mul(_H_CLEAR)

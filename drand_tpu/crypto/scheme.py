@@ -1,0 +1,36 @@
+"""The suite facade — the capability boundary of the crypto engine.
+
+Mirrors the reference's key/curve.go globals (the `Scheme` boundary that
+BASELINE.json names as the swap point for the TPU engine):
+
+    Pairing    -> drand_tpu.crypto.pairing
+    KeyGroup   -> PointG1 (keys, 48B)
+    SigGroup   -> PointG2 (signatures, 96B)
+    Scheme     -> tbls module (threshold BLS on G2)
+    AuthScheme -> bls module (plain BLS on G2)
+    DKGAuthScheme -> schnorr module (Schnorr on G1)
+
+Protocol code imports THIS module, never the primitives directly, so the
+batched TPU engine (drand_tpu.ops) can be slotted behind the same calls.
+"""
+
+from __future__ import annotations
+
+from . import bls as auth_scheme               # noqa: F401
+from . import schnorr as dkg_auth_scheme       # noqa: F401
+from . import tbls as scheme                   # noqa: F401
+from . import ecies                            # noqa: F401
+from . import timelock                         # noqa: F401
+from .curves import PointG1 as KeyGroup        # noqa: F401
+from .curves import PointG2 as SigGroup        # noqa: F401
+from .hash_to_curve import DEFAULT_DST_G2      # noqa: F401
+from .poly import (                            # noqa: F401
+    PriPoly,
+    PriShare,
+    PubPoly,
+    PubShare,
+    lagrange_coefficients,
+    minimum_threshold,
+    recover_commit,
+    recover_secret,
+)

@@ -1,0 +1,61 @@
+"""Schnorr signatures over G1.
+
+The reference's DKGAuthScheme (key/curve.go:38): authenticates DKG broadcast
+packets (core/broadcast.go via dkg.VerifyPacketSignature) and the leader's
+signed group file (core/drand_control.go:714, core/group_setup.go:329).
+
+sig = R_bytes || s_bytes with R = k*G1, s = k + H(R || pub || msg)*sk.
+Challenge hash is SHA-256 reduced into Fr.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+
+from .fields import R, fr_from_bytes_wide
+from .curves import PointG1
+
+SIG_SIZE = PointG1.COMPRESSED_SIZE + 32  # 80 bytes
+
+
+def _challenge(big_r: PointG1, pub: PointG1, msg: bytes) -> int:
+    h = hashlib.sha256()
+    h.update(big_r.to_bytes())
+    h.update(pub.to_bytes())
+    h.update(msg)
+    return fr_from_bytes_wide(h.digest())
+
+
+def _nonce(sk: int, msg: bytes) -> int:
+    """Deterministic nonce (RFC 6979 flavour): HMAC(sk, msg) into Fr.
+    Avoids catastrophic nonce reuse without an RNG dependency."""
+    key = sk.to_bytes(32, "big")
+    out = hmac.new(key, b"drand-tpu-schnorr-nonce" + msg, hashlib.sha256).digest()
+    out2 = hmac.new(key, out + msg, hashlib.sha256).digest()
+    k = fr_from_bytes_wide(out + out2)
+    return k if k != 0 else 1
+
+
+def sign(sk: int, msg: bytes) -> bytes:
+    k = _nonce(sk, msg)
+    big_r = PointG1.generator().mul(k)
+    pub = PointG1.generator().mul(sk)
+    c = _challenge(big_r, pub, msg)
+    s = (k + c * sk) % R
+    return big_r.to_bytes() + s.to_bytes(32, "big")
+
+
+def verify(pub: PointG1, msg: bytes, sig: bytes) -> bool:
+    if len(sig) != SIG_SIZE:
+        return False
+    try:
+        big_r = PointG1.from_bytes(sig[: PointG1.COMPRESSED_SIZE])
+    except ValueError:
+        return False
+    s = int.from_bytes(sig[PointG1.COMPRESSED_SIZE :], "big")
+    if s >= R:
+        return False
+    c = _challenge(big_r, pub, msg)
+    # s*G == R + c*pub
+    return PointG1.generator().mul(s) == big_r + pub.mul(c)

@@ -1,0 +1,107 @@
+"""Timelock encryption over the beacon's unchained V2 signatures (IBE).
+
+Reproduces the fork-specific capability demoed in
+/root/reference/core/timelock_test.go:17-72 using kyber/encrypt/timelock:
+encrypt a message to a FUTURE round; the round's V2 beacon signature (over
+H(round) only — chain/beacon.go:110) is the IBE private key that decrypts it.
+
+Boneh-Franklin style over the BLS12-381 pairing with drand's key layout
+(master public key on G1, identity hashed to G2):
+
+    encrypt(pub, round):  id = MessageV2(round); Q_id = H2(id) in G2
+        sigma <- random 32B; r = H3(sigma || M) in Fr
+        U = r * G1;  V = sigma XOR H_GT(e(pub, Q_id)^r);  W = M XOR H4(sigma)
+    decrypt(sig_v2):      e(U, sig_v2) == e(pub, Q_id)^r  recovers sigma.
+
+The Fujisaki-Okamoto re-encryption check (recompute r from sigma and test
+U == r*G1) makes the scheme CCA-secure and rejects tampering.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+from dataclasses import dataclass
+
+from .fields import R, Fp12, fr_from_bytes_wide
+from .curves import PointG1, PointG2
+from .hash_to_curve import hash_to_g2
+from .pairing import pairing
+
+SIGMA_LEN = 32
+
+
+def _gt_to_bytes(e: Fp12) -> bytes:
+    """Canonical GT serialization: the 12 Fp coefficients, c0-tower first,
+    each 48-byte big-endian."""
+    out = b""
+    for six in (e.c0, e.c1):
+        for two in (six.c0, six.c1, six.c2):
+            out += two.c0.to_bytes(48, "big") + two.c1.to_bytes(48, "big")
+    return out
+
+
+def _h_gt(e: Fp12) -> bytes:
+    return hashlib.sha256(b"IBE-H2" + _gt_to_bytes(e)).digest()
+
+
+def _h3(sigma: bytes, msg: bytes) -> int:
+    h = hashlib.sha256(b"IBE-H3" + sigma + msg).digest()
+    h2 = hashlib.sha256(b"IBE-H3b" + sigma + msg).digest()
+    v = fr_from_bytes_wide(h + h2)
+    return v if v != 0 else 1
+
+def _h4(sigma: bytes, n: int) -> bytes:
+    out = b""
+    ctr = 0
+    while len(out) < n:
+        out += hashlib.sha256(b"IBE-H4" + ctr.to_bytes(2, "big") + sigma).digest()
+        ctr += 1
+    return out[:n]
+
+
+def _xor(a: bytes, b: bytes) -> bytes:
+    return bytes(x ^ y for x, y in zip(a, b))
+
+
+@dataclass(frozen=True)
+class Ciphertext:
+    u: bytes  # 48B compressed G1 point
+    v: bytes  # SIGMA_LEN bytes
+    w: bytes  # len(message) bytes
+
+    def to_bytes(self) -> bytes:
+        return self.u + self.v + self.w
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "Ciphertext":
+        if len(data) < PointG1.COMPRESSED_SIZE + SIGMA_LEN:
+            raise ValueError("ciphertext too short")
+        off = PointG1.COMPRESSED_SIZE
+        return Ciphertext(data[:off], data[off : off + SIGMA_LEN], data[off + SIGMA_LEN :])
+
+
+def encrypt(pubkey: PointG1, identity: bytes, msg: bytes) -> Ciphertext:
+    """Encrypt to the holder of the BLS signature over `identity` (for the
+    beacon: identity = chain.MessageV2(round))."""
+    q_id = hash_to_g2(identity)
+    sigma = secrets.token_bytes(SIGMA_LEN)
+    r = _h3(sigma, msg)
+    u = PointG1.generator().mul(r)
+    g_id_r = pairing(pubkey, q_id).pow(r)
+    v = _xor(sigma, _h_gt(g_id_r))
+    w = _xor(msg, _h4(sigma, len(msg)))
+    return Ciphertext(u.to_bytes(), v, w)
+
+
+def decrypt(signature: bytes | PointG2, ct: Ciphertext) -> bytes:
+    """Decrypt with the round's full BLS signature (V2). Raises ValueError
+    on tampering (FO re-encryption check)."""
+    sig = signature if isinstance(signature, PointG2) else PointG2.from_bytes(signature)
+    u = PointG1.from_bytes(ct.u)
+    sigma = _xor(ct.v, _h_gt(pairing(u, sig)))
+    msg = _xor(ct.w, _h4(sigma, len(ct.w)))
+    r = _h3(sigma, msg)
+    if PointG1.generator().mul(r) != u:
+        raise ValueError("timelock decryption failed: invalid ciphertext or wrong round signature")
+    return msg

@@ -1,0 +1,139 @@
+"""Group file: the canonical network configuration.
+
+Reference: key/group.go — nodes, threshold, period, genesis time/seed,
+transition time, distributed key, and a canonical blake2b hash that pins
+the network identity (the genesis seed of the chain).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+from .keys import DistPublic, Identity, Node
+from ..crypto.poly import minimum_threshold
+from ..chain import time_math
+
+
+@dataclass
+class Group:
+    nodes: list[Node]
+    threshold: int
+    period: int  # seconds
+    genesis_time: int
+    genesis_seed: bytes = b""
+    transition_time: int = 0
+    catchup_period: int = 0
+    public_key: DistPublic | None = None
+
+    def __post_init__(self):
+        self.nodes = sorted(self.nodes, key=lambda n: n.index)
+        if self.threshold < minimum_threshold(len(self.nodes)):
+            raise ValueError(
+                f"threshold {self.threshold} below minimum "
+                f"{minimum_threshold(len(self.nodes))} for n={len(self.nodes)}"
+            )
+        if self.catchup_period == 0:
+            self.catchup_period = max(1, self.period // 2)
+
+    def __len__(self) -> int:
+        return len(self.nodes)
+
+    def find(self, ident: Identity) -> Node | None:
+        for n in self.nodes:
+            if n.identity.equal(ident):
+                return n
+        return None
+
+    def find_index(self, ident: Identity) -> int | None:
+        n = self.find(ident)
+        return None if n is None else n.index
+
+    def node(self, index: int) -> Node | None:
+        for n in self.nodes:
+            if n.index == index:
+                return n
+        return None
+
+    def hash(self) -> bytes:
+        """Canonical group hash (key/group.go:89): nodes sorted by index,
+        then threshold, genesis time, transition time, dist key."""
+        h = hashlib.blake2b(digest_size=32)
+        for n in self.nodes:
+            h.update(n.hash())
+        h.update(self.threshold.to_bytes(4, "little"))
+        h.update(int(self.genesis_time).to_bytes(8, "little", signed=True))
+        if self.transition_time:
+            h.update(int(self.transition_time).to_bytes(8, "little", signed=True))
+        if self.public_key is not None:
+            h.update(self.public_key.hash())
+        return h.digest()
+
+    def get_genesis_seed(self) -> bytes:
+        """The chain's genesis seed: fixed at first-group creation
+        (key/group.go GetGenesisSeed — the hash of the group)."""
+        if not self.genesis_seed:
+            self.genesis_seed = self.hash()
+        return self.genesis_seed
+
+    def current_round(self, now: float) -> int:
+        return time_math.current_round(int(now), self.period, self.genesis_time)
+
+    def equal(self, other: "Group") -> bool:
+        return self.hash() == other.hash() and self.period == other.period
+
+    # -- codec (the TOML-file analogue; JSON here) ---------------------------
+    def to_dict(self) -> dict:
+        d = {
+            "threshold": self.threshold,
+            "period": self.period,
+            "catchup_period": self.catchup_period,
+            "genesis_time": self.genesis_time,
+            "transition_time": self.transition_time,
+            "genesis_seed": self.get_genesis_seed().hex(),
+            "nodes": [
+                {
+                    "index": n.index,
+                    "address": n.identity.addr,
+                    "tls": n.identity.tls,
+                    "key": n.identity.key.to_bytes().hex(),
+                    "signature": n.identity.signature.hex(),
+                }
+                for n in self.nodes
+            ],
+        }
+        if self.public_key is not None:
+            d["public_key"] = [c.to_bytes().hex() for c in self.public_key.coefficients]
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "Group":
+        from ..crypto.curves import PointG1
+
+        nodes = [
+            Node(
+                identity=Identity(
+                    key=PointG1.from_bytes(bytes.fromhex(nd["key"])),
+                    addr=nd["address"],
+                    tls=nd.get("tls", False),
+                    signature=bytes.fromhex(nd.get("signature", "")),
+                ),
+                index=nd["index"],
+            )
+            for nd in d["nodes"]
+        ]
+        pk = None
+        if "public_key" in d:
+            pk = DistPublic(
+                [PointG1.from_bytes(bytes.fromhex(c)) for c in d["public_key"]]
+            )
+        return Group(
+            nodes=nodes,
+            threshold=d["threshold"],
+            period=d["period"],
+            genesis_time=d["genesis_time"],
+            genesis_seed=bytes.fromhex(d.get("genesis_seed", "")),
+            transition_time=d.get("transition_time", 0),
+            catchup_period=d.get("catchup_period", 0),
+            public_key=pk,
+        )

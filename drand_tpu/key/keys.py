@@ -1,0 +1,120 @@
+"""Long-term identity keys, DKG shares, distributed public key.
+
+Reference: key/keys.go (Pair :20, Identity :28, NewKeyPair :88, Share :235,
+DistPublic :311) and key/node.go (Node :22). Keys live on G1 (48 bytes),
+identity self-signatures are BLS on G2 (AuthScheme — key/curve.go:34).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+from ..crypto import bls
+from ..crypto.curves import PointG1
+from ..crypto.poly import PriShare, PubPoly
+
+
+@dataclass
+class Identity:
+    """Public identity: key + reachable address (key/keys.go:28)."""
+
+    key: PointG1
+    addr: str = ""
+    tls: bool = False
+    signature: bytes = b""
+
+    def address(self) -> str:
+        return self.addr
+
+    def hash(self) -> bytes:
+        """Hash of the public key only — the self-signature input
+        (key/keys.go:54: address/tls excluded so they can change)."""
+        return hashlib.blake2b(self.key.to_bytes(), digest_size=32).digest()
+
+    def valid_signature(self) -> bool:
+        return bls.verify(self.key, self.hash(), self.signature)
+
+    def equal(self, other: "Identity") -> bool:
+        return (
+            self.addr == other.addr
+            and self.tls == other.tls
+            and self.key == other.key
+        )
+
+    def __str__(self) -> str:
+        return f"{{{self.addr} - {self.key.to_bytes()[:8].hex()}}}"
+
+
+@dataclass
+class Pair:
+    """Private/public keypair (key/keys.go:20)."""
+
+    key: int  # Fr scalar
+    public: Identity
+
+    def self_sign(self) -> None:
+        self.public.signature = bls.sign(self.key, self.public.hash())
+
+
+def new_key_pair(address: str, tls: bool = False, seed: bytes | None = None) -> Pair:
+    """Fresh self-signed keypair (key/keys.go:88)."""
+    sk, pub = bls.keygen(seed=seed)
+    pair = Pair(key=sk, public=Identity(key=pub, addr=address, tls=tls))
+    pair.self_sign()
+    return pair
+
+
+@dataclass
+class Node:
+    """Identity with its DKG index (key/node.go:22)."""
+
+    identity: Identity
+    index: int
+
+    def address(self) -> str:
+        return self.identity.addr
+
+    def hash(self) -> bytes:
+        h = hashlib.blake2b(digest_size=32)
+        h.update(self.index.to_bytes(2, "big"))
+        h.update(self.identity.key.to_bytes())
+        return h.digest()
+
+
+@dataclass
+class DistPublic:
+    """The distributed public key: commitments of the collective secret
+    polynomial; coefficient 0 is the collective key (key/keys.go:311)."""
+
+    coefficients: list[PointG1]
+
+    def key(self) -> PointG1:
+        return self.coefficients[0]
+
+    def pub_poly(self) -> PubPoly:
+        return PubPoly(list(self.coefficients))
+
+    def hash(self) -> bytes:
+        h = hashlib.blake2b(digest_size=32)
+        for c in self.coefficients:
+            h.update(c.to_bytes())
+        return h.digest()
+
+    def equal(self, other: "DistPublic") -> bool:
+        return self.coefficients == other.coefficients
+
+
+@dataclass
+class Share:
+    """Output of the DKG for one node: its private share plus the public
+    polynomial commitments (key/keys.go:235)."""
+
+    commits: list[PointG1]
+    pri_share: PriShare
+
+    def public(self) -> DistPublic:
+        return DistPublic(list(self.commits))
+
+    def pub_poly(self) -> PubPoly:
+        return PubPoly(list(self.commits))

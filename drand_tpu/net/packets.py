@@ -1,0 +1,43 @@
+"""Wire packet dataclasses — the protobuf message analogues.
+
+Reference: protobuf/drand/protocol.proto (PartialBeaconPacket :63-75,
+SyncRequest/BeaconPacket :37-61). The gRPC transport serializes these;
+the in-memory test transport passes them directly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..chain.beacon import Beacon
+
+
+@dataclass(frozen=True)
+class PartialBeaconPacket:
+    round: int
+    previous_sig: bytes
+    partial_sig: bytes      # 2B index || 96B G2 sig over Message(round, prev)
+    partial_sig_v2: bytes   # 2B index || 96B G2 sig over MessageV2(round)
+
+
+@dataclass(frozen=True)
+class SyncRequest:
+    from_round: int
+
+
+def beacon_to_packet(b: Beacon) -> dict:
+    return {
+        "round": b.round,
+        "previous_sig": b.previous_sig,
+        "signature": b.signature,
+        "signature_v2": b.signature_v2,
+    }
+
+
+def packet_to_beacon(d: dict) -> Beacon:
+    return Beacon(
+        round=d["round"],
+        previous_sig=d["previous_sig"],
+        signature=d["signature"],
+        signature_v2=d.get("signature_v2", b""),
+    )

@@ -1,0 +1,153 @@
+"""Transport abstraction between nodes.
+
+The protocol plane is transport-agnostic: the beacon engine and DKG talk to
+a ``ProtocolClient`` and expose a ``ProtocolService``; implementations are
+the in-memory ``LocalNetwork`` (tests — the DrandTest2 analogue,
+core/util_test.go:32) and the gRPC transport (drand_tpu.net.grpc).
+
+Reference: net/client.go:30 (ProtocolClient), net/gateway.go:44 (Service).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import AsyncIterator, Protocol
+
+from .packets import PartialBeaconPacket, SyncRequest
+from ..chain.beacon import Beacon
+
+
+class Peer(Protocol):
+    def address(self) -> str: ...
+
+
+class TransportError(Exception):
+    pass
+
+
+class ProtocolClient:
+    """Outbound node->node calls (reference net/client.go:30-49)."""
+
+    async def partial_beacon(self, peer, packet: PartialBeaconPacket) -> None:
+        raise NotImplementedError
+
+    async def sync_chain(self, peer, req: SyncRequest) -> AsyncIterator[Beacon]:
+        raise NotImplementedError
+
+    async def broadcast_dkg(self, peer, packet) -> None:
+        raise NotImplementedError
+
+    async def signal_dkg_participant(self, peer, packet) -> None:
+        raise NotImplementedError
+
+    async def push_dkg_info(self, peer, packet) -> None:
+        raise NotImplementedError
+
+    async def chain_info(self, peer) -> "Info":
+        raise NotImplementedError
+
+    async def get_identity(self, peer) -> dict:
+        raise NotImplementedError
+
+
+class ProtocolService:
+    """Inbound service surface a node registers on its transport
+    (reference protobuf/drand/protocol.proto:16-33)."""
+
+    async def process_partial_beacon(self, from_addr: str, packet: PartialBeaconPacket) -> None:
+        raise NotImplementedError
+
+    def sync_chain(self, from_addr: str, req: SyncRequest) -> AsyncIterator[Beacon]:
+        raise NotImplementedError
+
+    async def broadcast_dkg(self, from_addr: str, packet) -> None:
+        raise NotImplementedError
+
+    async def signal_dkg_participant(self, from_addr: str, packet) -> None:
+        raise NotImplementedError
+
+    async def push_dkg_info(self, from_addr: str, packet) -> None:
+        raise NotImplementedError
+
+    async def chain_info(self, from_addr: str):
+        raise NotImplementedError
+
+    async def get_identity(self, from_addr: str) -> dict:
+        raise NotImplementedError
+
+
+class LocalNetwork:
+    """In-process network: address -> service registry, with fault
+    injection (deny lists, drop rates) mirroring the reference's DenyClient
+    (core/util_test.go:450-478)."""
+
+    def __init__(self, seed: int = 0):
+        self._services: dict[str, ProtocolService] = {}
+        self._deny: set[tuple[str, str]] = set()  # (src, dst) pairs
+        self._down: set[str] = set()
+        self._rng = random.Random(seed)
+
+    def register(self, address: str, service: ProtocolService) -> None:
+        self._services[address] = service
+
+    def unregister(self, address: str) -> None:
+        self._services.pop(address, None)
+
+    # -- fault injection ----------------------------------------------------
+    def deny(self, src: str, dst: str) -> None:
+        self._deny.add((src, dst))
+
+    def allow(self, src: str, dst: str) -> None:
+        self._deny.discard((src, dst))
+
+    def set_down(self, address: str, down: bool = True) -> None:
+        (self._down.add if down else self._down.discard)(address)
+
+    def _target(self, src: str, peer) -> ProtocolService:
+        dst = peer.address() if hasattr(peer, "address") else str(peer)
+        if (src, dst) in self._deny:
+            raise TransportError(f"{src} -> {dst}: denied (fault injection)")
+        if dst in self._down or dst not in self._services:
+            raise TransportError(f"{dst}: unreachable")
+        if src in self._down:
+            raise TransportError(f"{src}: sender down")
+        return self._services[dst]
+
+    def client_for(self, address: str) -> "LocalClient":
+        return LocalClient(self, address)
+
+
+class LocalClient(ProtocolClient):
+    def __init__(self, network: LocalNetwork, address: str):
+        self._net = network
+        self._addr = address
+
+    async def partial_beacon(self, peer, packet: PartialBeaconPacket) -> None:
+        svc = self._net._target(self._addr, peer)
+        await svc.process_partial_beacon(self._addr, packet)
+
+    async def sync_chain(self, peer, req: SyncRequest) -> AsyncIterator[Beacon]:
+        svc = self._net._target(self._addr, peer)
+        async for b in svc.sync_chain(self._addr, req):
+            yield b
+
+    async def broadcast_dkg(self, peer, packet) -> None:
+        svc = self._net._target(self._addr, peer)
+        await svc.broadcast_dkg(self._addr, packet)
+
+    async def signal_dkg_participant(self, peer, packet) -> None:
+        svc = self._net._target(self._addr, peer)
+        await svc.signal_dkg_participant(self._addr, packet)
+
+    async def push_dkg_info(self, peer, packet) -> None:
+        svc = self._net._target(self._addr, peer)
+        await svc.push_dkg_info(self._addr, packet)
+
+    async def chain_info(self, peer):
+        svc = self._net._target(self._addr, peer)
+        return await svc.chain_info(self._addr)
+
+    async def get_identity(self, peer) -> dict:
+        svc = self._net._target(self._addr, peer)
+        return await svc.get_identity(self._addr)

@@ -1,0 +1,47 @@
+"""Leveled key-value logger (reference: log/log.go — go-kit style kv pairs)."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_FORMAT = "%(asctime)s %(levelname).1s %(name)s %(message)s"
+
+
+def _fmt_kv(args: tuple, kwargs: dict) -> str:
+    parts = [str(a) for a in args]
+    parts += [f"{k}={v}" for k, v in kwargs.items()]
+    return " ".join(parts)
+
+
+class KVLogger:
+    """logger.info("beacon_loop", round=12, last=11) style."""
+
+    def __init__(self, name: str, level: int = logging.INFO):
+        self._log = logging.getLogger(name)
+        self._log.setLevel(level)
+
+    def named(self, suffix: str) -> "KVLogger":
+        return KVLogger(f"{self._log.name}.{suffix}", self._log.level)
+
+    def debug(self, *args, **kwargs):
+        self._log.debug(_fmt_kv(args, kwargs))
+
+    def info(self, *args, **kwargs):
+        self._log.info(_fmt_kv(args, kwargs))
+
+    def warn(self, *args, **kwargs):
+        self._log.warning(_fmt_kv(args, kwargs))
+
+    def error(self, *args, **kwargs):
+        self._log.error(_fmt_kv(args, kwargs))
+
+
+def default_logger(name: str = "drand", level: str = "info") -> KVLogger:
+    lvl = {"none": logging.CRITICAL, "info": logging.INFO, "debug": logging.DEBUG}[level]
+    root = logging.getLogger()
+    if not root.handlers:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter(_FORMAT))
+        root.addHandler(h)
+    return KVLogger(name, lvl)

@@ -1,0 +1,158 @@
+"""Beacon-engine tests: the SURVEY.md §7 minimum end-to-end slice.
+
+An in-process t-of-n network over the in-memory transport with a fake
+clock — the TestBeaconSimple / TestBeaconSync analogues
+(reference: chain/beacon/node_test.go:372-520).
+"""
+
+import asyncio
+
+import pytest
+
+from drand_tpu.chain.beacon import verify_beacon, verify_beacon_v2
+from drand_tpu.chain.engine.cache import MAX_PARTIALS_PER_NODE, PartialCache
+from drand_tpu.net.packets import PartialBeaconPacket
+from drand_tpu.testing.harness import BeaconTestNetwork, synthesize_shares
+from drand_tpu.crypto import tbls
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+N, T, PERIOD = 3, 2, 10
+
+
+class TestBeaconSimple:
+    def test_rounds_produced_and_verified(self):
+        async def main():
+            net = BeaconTestNetwork(n=N, t=T, period=PERIOD)
+            await net.start_all()
+            await net.advance_to_genesis()
+            rounds = 4
+            for r in range(1, rounds + 1):
+                for i in range(N):
+                    await net.wait_round(i, r)
+                await net.clock.advance(PERIOD)
+            # all nodes converged on the same, verifying chain
+            pub = net.group.public_key.key()
+            ref_chain = list(net.nodes[0].store.cursor())
+            assert ref_chain[0].round == 0  # genesis
+            assert ref_chain[-1].round >= rounds
+            for b in ref_chain[1:]:
+                assert verify_beacon(pub, b), f"round {b.round} V1 invalid"
+                assert b.is_v2() and verify_beacon_v2(pub, b), f"round {b.round} V2 invalid"
+            # chaining: previous_sig links
+            for prev, cur in zip(ref_chain, ref_chain[1:]):
+                assert cur.previous_sig == prev.signature
+            for node in net.nodes[1:]:
+                for b_ref, b in zip(ref_chain, node.store.cursor()):
+                    assert b_ref.equal(b), "chains diverged"
+            net.stop_all()
+
+        run(main())
+
+    def test_only_threshold_nodes_needed(self):
+        async def main():
+            net = BeaconTestNetwork(n=N, t=T, period=PERIOD)
+            # only start T nodes: chain must still advance
+            await net.start_all(indices=list(range(T)))
+            await net.advance_to_genesis()
+            for r in range(1, 3):
+                for i in range(T):
+                    await net.wait_round(i, r)
+                await net.clock.advance(PERIOD)
+            assert net.nodes[0].store.last().round >= 2
+            net.stop_all()
+
+        run(main())
+
+    def test_below_threshold_stalls(self):
+        async def main():
+            net = BeaconTestNetwork(n=N, t=T, period=PERIOD)
+            await net.start_all(indices=[0])  # 1 < t nodes
+            await net.advance_to_genesis()
+            await net.clock.advance(PERIOD)
+            await net.clock.advance(PERIOD)
+            await asyncio.sleep(0.3)
+            assert net.nodes[0].store.last().round == 0  # still at genesis
+            net.stop_all()
+
+        run(main())
+
+
+class TestBeaconSync:
+    def test_node_catches_up_after_downtime(self):
+        async def main():
+            net = BeaconTestNetwork(n=N, t=T, period=PERIOD)
+            await net.start_all()
+            await net.advance_to_genesis()
+            # run 2 rounds with everyone
+            for r in range(1, 3):
+                for i in range(N):
+                    await net.wait_round(i, r)
+                await net.clock.advance(PERIOD)
+            # partition node 2 (its partials still flow out, incoming blocked)
+            addr2 = net.nodes[2].addr
+            for other in (0, 1):
+                net.network.deny(net.nodes[other].addr, addr2)
+                net.network.deny(addr2, net.nodes[other].addr)
+            for r in range(3, 5):
+                for i in (0, 1):
+                    await net.wait_round(i, r)
+                await net.clock.advance(PERIOD)
+            assert net.nodes[2].store.last().round < net.nodes[0].store.last().round
+            # heal the partition; next tick triggers gap-sync
+            for other in (0, 1):
+                net.network.allow(net.nodes[other].addr, addr2)
+                net.network.allow(addr2, net.nodes[other].addr)
+            target = net.nodes[0].store.last().round + 1
+            await net.clock.advance(PERIOD)
+            await net.wait_round(2, target)
+            b_behind = net.nodes[2].store.get(3)
+            assert b_behind is not None and b_behind.equal(net.nodes[0].store.get(3))
+            net.stop_all()
+
+        run(main())
+
+
+class TestPartialCacheDoS:
+    def _packet(self, round_no: int, idx: int, tag: bytes = b"") -> PartialBeaconPacket:
+        sig = idx.to_bytes(2, "big") + (tag or round_no.to_bytes(4, "big")) * 24
+        return PartialBeaconPacket(
+            round=round_no, previous_sig=b"prev%d" % round_no,
+            partial_sig=sig[:98].ljust(98, b"\x00"), partial_sig_v2=b"")
+
+    def test_round_window_eviction(self):
+        cache = PartialCache()
+        for r in range(1, 6):
+            cache.append(self._packet(r, idx=1))
+        assert len(cache.rounds) == 5
+        cache.flush_rounds(3)
+        assert all(c.round > 3 for c in cache.rounds.values())
+
+    def test_per_node_bound(self):
+        cache = PartialCache()
+        # node index 7 floods many distinct rounds
+        for r in range(1, MAX_PARTIALS_PER_NODE + 50):
+            cache.append(self._packet(r, idx=7))
+        assert len(cache.rcvd[7]) <= MAX_PARTIALS_PER_NODE
+        # oldest entries were evicted
+        assert cache.get_round_cache(1, b"prev1") is None
+
+    def test_duplicate_partial_ignored(self):
+        cache = PartialCache()
+        p = self._packet(1, idx=3)
+        cache.append(p)
+        cache.append(p)
+        rc = cache.get_round_cache(1, b"prev1")
+        assert len(rc) == 1
+
+
+class TestShareSynthesis:
+    def test_partials_recover(self):
+        shares, dist = synthesize_shares(5, 3, seed=b"x")
+        msg = b"some round message"
+        partials = [tbls.sign_partial(s.pri_share, msg) for s in shares[:3]]
+        sig = tbls.recover(shares[0].pub_poly(), msg, partials, 3, 5)
+        assert tbls.verify_recovered(dist.key(), msg, sig)

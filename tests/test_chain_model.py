@@ -1,0 +1,174 @@
+"""Chain-model tests: time math, beacon messages, stores.
+Mirrors reference chain/time_test.go, chain/beacon.go semantics."""
+
+import os
+import tempfile
+
+import pytest
+
+from drand_tpu.chain import time_math
+from drand_tpu.chain.beacon import (
+    Beacon,
+    message,
+    message_v2,
+    randomness_from_signature,
+    round_to_bytes,
+)
+from drand_tpu.chain.store import (
+    AppendStore,
+    CallbackStore,
+    MemStore,
+    SQLiteStore,
+    StoreError,
+    genesis_beacon,
+)
+from drand_tpu.chain.info import Info
+from drand_tpu.crypto.curves import PointG1
+
+
+class TestTimeMath:
+    PERIOD, GENESIS = 30, 1_700_000_000
+
+    def test_round_zero_is_genesis(self):
+        assert time_math.time_of_round(self.PERIOD, self.GENESIS, 0) == self.GENESIS
+
+    def test_round_one_at_genesis(self):
+        assert time_math.time_of_round(self.PERIOD, self.GENESIS, 1) == self.GENESIS
+
+    def test_round_k(self):
+        assert (
+            time_math.time_of_round(self.PERIOD, self.GENESIS, 10)
+            == self.GENESIS + 9 * self.PERIOD
+        )
+
+    def test_next_round_before_genesis(self):
+        r, t = time_math.next_round(self.GENESIS - 100, self.PERIOD, self.GENESIS)
+        assert (r, t) == (1, self.GENESIS)
+
+    def test_next_round_progression(self):
+        # right at genesis, round 1 is current; next is 2
+        r, t = time_math.next_round(self.GENESIS, self.PERIOD, self.GENESIS)
+        assert r == 2 and t == self.GENESIS + self.PERIOD
+        assert time_math.current_round(self.GENESIS, self.PERIOD, self.GENESIS) == 1
+        mid = self.GENESIS + self.PERIOD + 3
+        assert time_math.current_round(mid, self.PERIOD, self.GENESIS) == 2
+
+    def test_time_round_inverse(self):
+        for k in (1, 2, 77, 10_000):
+            t = time_math.time_of_round(self.PERIOD, self.GENESIS, k)
+            assert time_math.current_round(t, self.PERIOD, self.GENESIS) == k
+
+    def test_overflow_guard(self):
+        assert (
+            time_math.time_of_round(self.PERIOD, self.GENESIS, 1 << 62)
+            == time_math.TIME_OF_ROUND_ERROR_VALUE
+        )
+
+
+class TestBeaconModel:
+    def test_message_derivation(self):
+        prev = b"\xaa" * 96
+        assert message(5, prev) != message(6, prev)
+        assert message(5, prev) != message(5, b"\xbb" * 96)
+        assert message_v2(5) == message_v2(5)
+        assert message_v2(5) != message_v2(6)
+        # V1 message binds the previous signature; V2 does not
+        assert message(5, prev) != message_v2(5)
+        assert round_to_bytes(1) == b"\x00" * 7 + b"\x01"
+
+    def test_randomness_is_sha256_of_sig(self):
+        import hashlib
+
+        sig = b"\x01" * 96
+        b = Beacon(round=1, previous_sig=b"", signature=sig)
+        assert b.randomness() == hashlib.sha256(sig).digest()
+        assert randomness_from_signature(sig) == b.randomness()
+
+    def test_marshal_roundtrip(self):
+        b = Beacon(round=7, previous_sig=b"\x01" * 96, signature=b"\x02" * 96,
+                   signature_v2=b"\x03" * 96)
+        assert Beacon.unmarshal(b.marshal()).equal(b)
+        b2 = Beacon(round=7, previous_sig=b"\x01" * 96, signature=b"\x02" * 96)
+        assert not b2.is_v2()
+        assert Beacon.unmarshal(b2.marshal()).equal(b2)
+
+
+def _mk_chain(k: int) -> list[Beacon]:
+    out = [Beacon(round=0, previous_sig=b"", signature=b"genesis")]
+    for i in range(1, k + 1):
+        out.append(
+            Beacon(round=i, previous_sig=out[-1].signature,
+                   signature=b"sig%d" % i)
+        )
+    return out
+
+
+class TestStores:
+    @pytest.mark.parametrize("backend", ["mem", "sqlite"])
+    def test_put_get_last_cursor(self, backend, tmp_path):
+        store = MemStore() if backend == "mem" else SQLiteStore(str(tmp_path / "c.db"))
+        chain = _mk_chain(5)
+        for b in chain:
+            store.put(b)
+        assert len(store) == 6
+        assert store.last().round == 5
+        assert store.get(3).signature == b"sig3"
+        assert store.get(99) is None
+        assert [b.round for b in store.cursor()] == list(range(6))
+        assert [b.round for b in store.cursor_from(3)] == [3, 4, 5]
+        store.del_round(5)
+        assert store.last().round == 4
+        store.close()
+
+    def test_sqlite_persistence(self, tmp_path):
+        path = str(tmp_path / "chain.db")
+        s1 = SQLiteStore(path)
+        for b in _mk_chain(3):
+            s1.put(b)
+        s1.close()
+        s2 = SQLiteStore(path)
+        assert s2.last().round == 3
+        assert s2.get(2).previous_sig == b"sig1"
+        s2.close()
+
+    def test_append_store_monotonicity(self):
+        inner = MemStore()
+        chain = _mk_chain(3)
+        inner.put(chain[0])
+        store = AppendStore(inner)
+        store.put(chain[1])
+        store.put(chain[2])
+        # skipping a round fails
+        with pytest.raises(StoreError):
+            store.put(Beacon(round=5, previous_sig=chain[2].signature, signature=b"x"))
+        # wrong previous signature fails
+        with pytest.raises(StoreError):
+            store.put(Beacon(round=3, previous_sig=b"wrong", signature=b"x"))
+        store.put(chain[3])
+        assert store.last().round == 3
+
+    def test_callback_store(self):
+        inner = MemStore()
+        chain = _mk_chain(2)
+        store = CallbackStore(inner)
+        seen = []
+        store.add_callback("t", lambda b: seen.append(b.round))
+        for b in chain:
+            store.put(b)
+        assert seen == [1, 2]  # genesis (round 0) never triggers callbacks
+        store.remove_callback("t")
+        store.put(Beacon(round=3, previous_sig=chain[-1].signature, signature=b"s3"))
+        assert seen == [1, 2]
+
+    def test_genesis_beacon(self):
+        info = Info(
+            public_key=PointG1.generator(),
+            period=30,
+            genesis_time=1000,
+            genesis_seed=b"\x42" * 32,
+        )
+        g = genesis_beacon(info)
+        assert g.round == 0 and g.signature == b"\x42" * 32
+        # info JSON codec
+        rt = Info.from_json(info.to_json())
+        assert rt.equal(info) and rt.hash() == info.hash()

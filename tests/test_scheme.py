@@ -1,0 +1,224 @@
+"""Scheme-layer tests: threshold BLS, polynomials, auth sigs, Schnorr,
+ECIES, timelock — reproducing the reference's crypto API surface
+(SURVEY.md §2.2)."""
+
+import hashlib
+
+import pytest
+
+from drand_tpu.crypto import bls, ecies, schnorr, tbls, timelock
+from drand_tpu.crypto.curves import PointG1, PointG2
+from drand_tpu.crypto.poly import (
+    PriPoly,
+    PriShare,
+    PubShare,
+    lagrange_coefficients,
+    minimum_threshold,
+    recover_commit,
+    recover_secret,
+)
+
+N, T = 5, 3
+MSG = hashlib.sha256(b"beacon round 1").digest()
+
+
+@pytest.fixture(scope="module")
+def dkg_setup():
+    """Synthesized shares, bypassing the DKG — the BeaconTest trick
+    (reference: chain/beacon/node_test.go:52-104 dkgShares)."""
+    poly = PriPoly.random(T, seed=b"test-dkg")
+    shares = poly.shares(N)
+    pub_poly = poly.commit()
+    return poly, shares, pub_poly
+
+
+class TestPoly:
+    def test_secret_recovery(self, dkg_setup):
+        poly, shares, _ = dkg_setup
+        assert recover_secret(shares[:T], T) == poly.secret()
+        assert recover_secret(shares[2:], T) == poly.secret()
+        with pytest.raises(ValueError):
+            recover_secret(shares[:T - 1], T)
+
+    def test_any_t_subset_recovers(self, dkg_setup):
+        poly, shares, _ = dkg_setup
+        import itertools
+
+        for combo in itertools.combinations(shares, T):
+            assert recover_secret(list(combo), T) == poly.secret()
+
+    def test_pub_poly_eval_matches_pri(self, dkg_setup):
+        _, shares, pub_poly = dkg_setup
+        for s in shares:
+            assert pub_poly.eval(s.index).value == PointG1.generator().mul(s.value)
+
+    def test_commit_is_public_key(self, dkg_setup):
+        poly, _, pub_poly = dkg_setup
+        assert pub_poly.commit() == PointG1.generator().mul(poly.secret())
+
+    def test_lagrange_sums_to_one_weighted(self):
+        # interpolating the constant polynomial: coefficients sum to 1
+        lambdas = lagrange_coefficients([0, 2, 4])
+        from drand_tpu.crypto.fields import R
+
+        assert sum(lambdas.values()) % R == 1
+
+    def test_poly_add(self):
+        a, b = PriPoly.random(T, seed=b"a"), PriPoly.random(T, seed=b"b")
+        s = a.add(b)
+        from drand_tpu.crypto.fields import R
+
+        assert s.secret() == (a.secret() + b.secret()) % R
+        assert a.commit().add(b.commit()).commit() == s.commit().commit()
+
+    def test_minimum_threshold(self):
+        assert minimum_threshold(4) == 3
+        assert minimum_threshold(5) == 3
+        assert minimum_threshold(10) == 6  # League of Entropy: 6-of-10
+
+
+class TestTBLS:
+    def test_partial_roundtrip(self, dkg_setup):
+        _, shares, pub_poly = dkg_setup
+        partial = tbls.sign_partial(shares[1], MSG)
+        assert len(partial) == tbls.PARTIAL_SIG_SIZE
+        assert tbls.index_of(partial) == 1
+        assert tbls.verify_partial(pub_poly, MSG, partial)
+
+    def test_partial_wrong_msg_or_index(self, dkg_setup):
+        _, shares, pub_poly = dkg_setup
+        partial = tbls.sign_partial(shares[1], MSG)
+        assert not tbls.verify_partial(pub_poly, b"other", partial)
+        # re-prefix with a wrong index: points at another node's pubkey share
+        forged = (2).to_bytes(2, "big") + partial[2:]
+        assert not tbls.verify_partial(pub_poly, MSG, forged)
+
+    def test_recover_and_verify(self, dkg_setup):
+        poly, shares, pub_poly = dkg_setup
+        partials = [tbls.sign_partial(s, MSG) for s in shares[:T]]
+        sig = tbls.recover(pub_poly, MSG, partials, T, N)
+        assert len(sig) == tbls.SIG_SIZE
+        assert tbls.verify_recovered(pub_poly.commit(), MSG, sig)
+        # recovered signature is the unique sk*H(m): any t-subset agrees
+        partials2 = [tbls.sign_partial(s, MSG) for s in shares[2:]]
+        assert tbls.recover(pub_poly, MSG, partials2, T, N) == sig
+        # and equals a direct signature under the (never-assembled) secret
+        direct = bls.sign(poly.secret(), MSG)
+        assert direct == sig
+
+    def test_recover_skips_garbage(self, dkg_setup):
+        _, shares, pub_poly = dkg_setup
+        partials = [b"\x00\x01garbage", tbls.sign_partial(shares[0], MSG)]
+        partials += [tbls.sign_partial(s, MSG) for s in shares[1:T]]
+        sig = tbls.recover(pub_poly, MSG, partials, T, N)
+        assert tbls.verify_recovered(pub_poly.commit(), MSG, sig)
+
+    def test_recover_insufficient(self, dkg_setup):
+        _, shares, pub_poly = dkg_setup
+        partials = [tbls.sign_partial(s, MSG) for s in shares[: T - 1]]
+        with pytest.raises(ValueError):
+            tbls.recover(pub_poly, MSG, partials, T, N)
+
+    def test_recover_commit_on_g2(self, dkg_setup):
+        poly, shares, _ = dkg_setup
+        h = PointG2.generator()
+        pshares = [PubShare(s.index, h.mul(s.value)) for s in shares[:T]]
+        assert recover_commit(pshares, T) == h.mul(poly.secret())
+
+
+class TestBLSAuth:
+    def test_sign_verify(self):
+        sk, pub = bls.keygen(seed=b"auth")
+        sig = bls.sign(sk, b"identity hash")
+        assert bls.verify(pub, b"identity hash", sig)
+        assert not bls.verify(pub, b"other", sig)
+        sk2, pub2 = bls.keygen(seed=b"auth2")
+        assert not bls.verify(pub2, b"identity hash", sig)
+
+    def test_malformed_sig(self):
+        _, pub = bls.keygen(seed=b"auth")
+        assert not bls.verify(pub, b"m", b"\x00" * 96)
+        assert not bls.verify(pub, b"m", b"short")
+        assert not bls.verify(pub, b"m", PointG2.infinity().to_bytes())
+
+
+class TestSchnorr:
+    def test_sign_verify(self):
+        sk, pub = bls.keygen(seed=b"schnorr")
+        sig = schnorr.sign(sk, b"dkg packet")
+        assert len(sig) == schnorr.SIG_SIZE
+        assert schnorr.verify(pub, b"dkg packet", sig)
+        assert not schnorr.verify(pub, b"tampered", sig)
+        _, pub2 = bls.keygen(seed=b"schnorr2")
+        assert not schnorr.verify(pub2, b"dkg packet", sig)
+
+    def test_deterministic(self):
+        sk, _ = bls.keygen(seed=b"schnorr")
+        assert schnorr.sign(sk, b"m") == schnorr.sign(sk, b"m")
+
+    def test_malformed(self):
+        _, pub = bls.keygen(seed=b"schnorr")
+        assert not schnorr.verify(pub, b"m", b"\x00" * schnorr.SIG_SIZE)
+        assert not schnorr.verify(pub, b"m", b"")
+
+
+class TestECIES:
+    def test_roundtrip(self):
+        sk, pub = bls.keygen(seed=b"ecies")
+        ct = ecies.encrypt(pub, b"private randomness 1234")
+        assert ecies.decrypt(sk, ct) == b"private randomness 1234"
+
+    def test_tamper_detected(self):
+        sk, pub = bls.keygen(seed=b"ecies")
+        ct = bytearray(ecies.encrypt(pub, b"secret"))
+        ct[-1] ^= 1
+        with pytest.raises(ValueError):
+            ecies.decrypt(sk, bytes(ct))
+
+    def test_wrong_key(self):
+        sk, pub = bls.keygen(seed=b"ecies")
+        sk2, _ = bls.keygen(seed=b"ecies-other")
+        ct = ecies.encrypt(pub, b"secret")
+        with pytest.raises(ValueError):
+            ecies.decrypt(sk2, ct)
+
+    def test_nondeterministic_ciphertexts(self):
+        _, pub = bls.keygen(seed=b"ecies")
+        assert ecies.encrypt(pub, b"m") != ecies.encrypt(pub, b"m")
+
+
+class TestTimelock:
+    """The fork's headline capability: encrypt-to-future-round
+    (reference: core/timelock_test.go:17-72)."""
+
+    def test_roundtrip_via_beacon_sig(self):
+        # network master key
+        sk, pub = bls.keygen(seed=b"timelock-master")
+        round_no = 1337
+        identity = hashlib.sha256(round_no.to_bytes(8, "big")).digest()  # MessageV2
+        ct = timelock.encrypt(pub, identity, b"to the future")
+        # ... later, round 1337's V2 signature is published:
+        sig_v2 = bls.sign(sk, identity)
+        assert timelock.decrypt(sig_v2, ct) == b"to the future"
+
+    def test_wrong_round_sig_fails(self):
+        sk, pub = bls.keygen(seed=b"timelock-master")
+        identity = hashlib.sha256((1).to_bytes(8, "big")).digest()
+        ct = timelock.encrypt(pub, identity, b"msg")
+        wrong_sig = bls.sign(sk, hashlib.sha256((2).to_bytes(8, "big")).digest())
+        with pytest.raises(ValueError):
+            timelock.decrypt(wrong_sig, ct)
+
+    def test_tampered_ciphertext_fails(self):
+        sk, pub = bls.keygen(seed=b"timelock-master")
+        identity = b"round-id"
+        ct = timelock.encrypt(pub, identity, b"msg12345")
+        bad = timelock.Ciphertext(ct.u, ct.v, bytes(len(ct.w)))
+        with pytest.raises(ValueError):
+            timelock.decrypt(bls.sign(sk, identity), bad)
+
+    def test_serialization(self):
+        _, pub = bls.keygen(seed=b"timelock-master")
+        ct = timelock.encrypt(pub, b"id", b"hello")
+        rt = timelock.Ciphertext.from_bytes(ct.to_bytes())
+        assert rt == ct
