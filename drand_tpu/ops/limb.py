@@ -1,0 +1,343 @@
+"""Multi-limb Fp arithmetic for BLS12-381 on TPU — the base of the batched
+crypto engine.
+
+Replaces the reference's native field arithmetic (kyber-bls12381 wrapping
+kilic/bls12-381, Go + x86-64 assembly — /root/reference/go.mod:9-10) with a
+TPU-native design:
+
+- An Fp element is a vector of ``NLIMBS = 32`` limbs of ``BITS = 12`` bits
+  stored little-endian in int32. 12-bit limbs are chosen so a full schoolbook
+  product fits int32 without widening: 32 * (2^12)^2 = 2^29, and Montgomery
+  accumulation stays under 2^31. No int64 anywhere (TPU-friendly).
+- Montgomery representation with R = 2^384. ``mont_mul`` is the single hot
+  primitive: schoolbook convolution + 32 unrolled Montgomery steps, all
+  element-wise over an arbitrary leading batch shape, so `vmap`/`pjit`
+  batching is plain broadcasting.
+- Lazy carries: limbs are kept in [0, 4096] (one over the 12-bit mask is
+  tolerated — it keeps every bound intact and avoids worst-case ripple
+  loops). Values live in [0, ~2^384); exact canonical form only matters at
+  equality checks, which go through ``is_zero_mod_p`` (an exact carry
+  scan + comparison against the 10 multiples of p below 2^384).
+
+Everything here is shape-static and jit-safe; functions take and return
+plain ``jnp.ndarray``s of trailing dimension ``NLIMBS``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..crypto.fields import P
+
+BITS = 12
+NLIMBS = 32
+MASK = (1 << BITS) - 1
+DTYPE = jnp.int32
+
+assert NLIMBS * BITS == 384
+assert NLIMBS * (MASK + 1) ** 2 <= 2**29, "convolution must fit int32"
+
+R_MONT = 1 << (BITS * NLIMBS)  # 2^384
+N0INV = pow(-P, -1, 1 << BITS)  # -p^-1 mod 2^BITS (Montgomery constant)
+
+
+# ---------------------------------------------------------------------------
+# Host-side conversions
+# ---------------------------------------------------------------------------
+
+def int_to_limbs(x: int, n: int = NLIMBS) -> np.ndarray:
+    """Little-endian limb decomposition of a non-negative int (host)."""
+    if x < 0:
+        raise ValueError("negative value")
+    out = np.zeros(n, dtype=np.int32)
+    for i in range(n):
+        out[i] = x & MASK
+        x >>= BITS
+    if x:
+        raise ValueError(f"value does not fit in {n} limbs")
+    return out
+
+
+def limbs_to_int(a) -> int:
+    """Reassemble a limb vector (any per-limb values) into an int (host)."""
+    a = np.asarray(a)
+    return sum(int(v) << (BITS * i) for i, v in enumerate(a.tolist()))
+
+
+def fp_to_device(x: int, mont: bool = True):
+    """Host int -> device limbs (Montgomery form by default)."""
+    if mont:
+        x = (x * R_MONT) % P
+    return jnp.asarray(int_to_limbs(x % P))
+
+
+def fp_from_device(a, mont: bool = True) -> int:
+    """Device limbs -> canonical host int."""
+    v = limbs_to_int(np.asarray(a)) % P
+    if mont:
+        v = (v * pow(R_MONT, -1, P)) % P
+    return v
+
+
+# ---------------------------------------------------------------------------
+# Device constants
+# ---------------------------------------------------------------------------
+
+P_LIMBS = np.asarray(int_to_limbs(P))
+# R mod p — the Montgomery form of 1
+ONE_MONT = np.asarray(int_to_limbs(R_MONT % P))
+ZERO = np.zeros(NLIMBS, dtype=np.int32)
+# R^2 mod p — to_mont multiplier
+R2 = np.asarray(int_to_limbs((R_MONT * R_MONT) % P))
+# Wrap rows: limbs of 2^(BITS*(NLIMBS+i)) mod p, for folding limbs >= 32
+# back under 2^384. Row count covers the 63-limb convolution output.
+_WRAP_ROWS = np.stack(
+    [int_to_limbs(pow(2, BITS * (NLIMBS + i), P)) for i in range(NLIMBS + 4)]
+)
+# Negation addend: value v with v ≡ -(2^385 - 2) (mod p), so that
+# (2^385-2) - b (a borrow-free per-limb complement) plus v is ≡ -b.
+_NEG_ADDEND = np.asarray(int_to_limbs((-(2**385 - 2)) % P))
+# Multiples of p below ~2^384: an exactly-normalized value < 2^384(1+eps)
+# is ≡ 0 mod p iff it equals one of these. 33 limbs (room for the eps).
+_P_MULTIPLES = np.stack(
+    [int_to_limbs(k * P, NLIMBS + 1) for k in range(R_MONT // P + 1)]
+)
+
+# Montgomery inner-step shift rows: row i holds P_LIMBS placed at offset i in
+# a 2*NLIMBS-wide vector (for the unrolled reduction's fused multiply-add).
+_P_SHIFT = np.zeros((NLIMBS, 2 * NLIMBS), dtype=np.int32)
+for _i in range(NLIMBS):
+    _P_SHIFT[_i, _i : _i + NLIMBS] = P_LIMBS
+_P_SHIFT.setflags(write=False)
+_WRAP_ROWS.setflags(write=False)
+_P_MULTIPLES.setflags(write=False)
+
+
+# ---------------------------------------------------------------------------
+# Carry folding and reduction
+# ---------------------------------------------------------------------------
+
+def _fold(t: jnp.ndarray, rounds: int, grow: bool = True) -> jnp.ndarray:
+    """Carry-fold: after `rounds` passes limbs are <= MASK+1 (the +1 ripple
+    edge is tolerated everywhere by design). grow=True appends one limb to
+    catch the final carry-out."""
+    if grow:
+        pad = [(0, 0)] * (t.ndim - 1) + [(0, 1)]
+        t = jnp.pad(t, pad)
+    for _ in range(rounds):
+        lo = t & MASK
+        carry = t >> BITS
+        t = lo + jnp.concatenate(
+            [jnp.zeros_like(carry[..., :1]), carry[..., :-1]], axis=-1
+        )
+    return t
+
+
+def _wrap(t: jnp.ndarray, passes: int) -> jnp.ndarray:
+    """Reduce a (..., >=NLIMBS)-limb value into NLIMBS limbs, preserving the
+    value mod p, by folding high limbs through 2^(12k) mod p. Each pass
+    shrinks the overflow geometrically; `passes` is sized by the caller's
+    input bound (2 covers anything below ~8*2^384)."""
+    for _ in range(passes):
+        if t.shape[-1] <= NLIMBS:
+            break
+        lo, hi = t[..., :NLIMBS], t[..., NLIMBS:]
+        rows = jnp.asarray(_WRAP_ROWS[: hi.shape[-1]])
+        red = jnp.sum(hi[..., None] * rows, axis=-2, dtype=DTYPE)
+        t = _fold(lo + red, rounds=3, grow=True)
+    return t[..., :NLIMBS]
+
+
+def reduce_limbs(t: jnp.ndarray, passes: int = 2, pre_rounds: int = 2) -> jnp.ndarray:
+    """Normalize arbitrary (..., K>=NLIMBS) limbs (each < ~2^30) to the
+    engine invariant: NLIMBS limbs in [0, 4096], value in [0, ~2^384)."""
+    t = _fold(t, rounds=pre_rounds, grow=True)
+    return _wrap(t, passes)
+
+
+# ---------------------------------------------------------------------------
+# Field ops (Montgomery domain)
+# ---------------------------------------------------------------------------
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return reduce_limbs(a + b)
+
+
+def add3(a: jnp.ndarray, b: jnp.ndarray, c: jnp.ndarray) -> jnp.ndarray:
+    return reduce_limbs(a + b + c)
+
+
+def neg(b: jnp.ndarray) -> jnp.ndarray:
+    # borrow-free complement: (2^385-2) - b has limbs 8190 - b_i >= 4094
+    comp = (2 * MASK) - b
+    return reduce_limbs(comp + jnp.asarray(_NEG_ADDEND))
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    comp = (2 * MASK) - b
+    return reduce_limbs(a + comp + jnp.asarray(_NEG_ADDEND))
+
+
+def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Multiply by a small non-negative int constant (k <= ~16)."""
+    return reduce_limbs(a * k)
+
+
+def double(a: jnp.ndarray) -> jnp.ndarray:
+    return mul_small(a, 2)
+
+
+def _shift_stack(b: jnp.ndarray, out_len: int) -> jnp.ndarray:
+    """(..., 32) -> (..., 32, out_len): row i is b shifted up by i limbs.
+    Static pads only — compile-cheap, fully parallel."""
+    nd = b.ndim - 1
+    rows = [
+        jnp.pad(b, [(0, 0)] * nd + [(i, out_len - NLIMBS - i)])
+        for i in range(NLIMBS)
+    ]
+    return jnp.stack(rows, axis=-2)
+
+
+def _conv_full(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Product convolution: (..., 32) x (..., 32) -> (..., 64), limb values
+    <= 2^29. One reduction over the limb axis — no sequential chain.
+    NB: explicit multiply+sum, NOT einsum/dot — integer dots may be lowered
+    through inexact float accumulation paths on some backends."""
+    bs = _shift_stack(b, 2 * NLIMBS)
+    return jnp.sum(a[..., None] * bs, axis=-2, dtype=DTYPE)
+
+
+def _conv_lo(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Low half of the convolution: result limbs 0..31 only (values mod-2^384
+    arithmetic — exactly what Montgomery's m needs)."""
+    bs = _shift_stack(b, 2 * NLIMBS)[..., :NLIMBS]
+    return jnp.sum(a[..., None] * bs, axis=-2, dtype=DTYPE)
+
+
+def _fold_drop(t: jnp.ndarray, rounds: int) -> jnp.ndarray:
+    """Carry-fold that DROPS carries out of the top limb: computes the limb
+    normalization of (value mod 2^(12*len))."""
+    for _ in range(rounds):
+        lo = t & MASK
+        carry = t >> BITS
+        t = lo + jnp.concatenate(
+            [jnp.zeros_like(carry[..., :1]), carry[..., :-1]], axis=-1
+        )
+    return t
+
+
+# -(p^-1) mod 2^384, as limbs — the full-width Montgomery constant
+_NPRIME_LIMBS = np.asarray(int_to_limbs((-pow(P, -1, R_MONT)) % R_MONT))
+
+
+def mont_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery product a * b * R^-1 mod p (REDC, fully parallel).
+
+        T = a*b
+        m = (T mod R) * (-p^-1) mod R
+        U = T + m*p          (U ≡ 0 mod R)
+        result = U / R  =  U_high + [U_low != 0]
+
+    The last step works because after carry-folding, U_low's value is a
+    multiple of R in [0, R(1+eps)) — i.e. exactly 0 or R — so the quotient
+    bit is just "any non-zero low limb". No sequential carry chain anywhere.
+
+    The optimization_barrier pins the operands: without it, an XLA:CPU
+    rewrite across stack/slice producer patterns miscompiles this graph
+    (observed on jax 0.9.0: jit(f12_mul) != eager f12_mul; the barrier is
+    load-bearing, do not remove without re-running the tower golden tests).
+    """
+    a, b = jax.lax.optimization_barrier((a, b))
+    t = _conv_full(a, b)  # (..., 64), limbs <= 2^29
+    t = _fold(t, rounds=3, grow=True)  # (..., 65), limbs <= 4096
+    m = _conv_lo(t[..., :NLIMBS], jnp.asarray(_NPRIME_LIMBS))
+    m = _fold_drop(m, rounds=3)  # limbs <= 4096, ≡ T*(-p^-1) mod R
+    u = _conv_full(m, jnp.asarray(P_LIMBS))  # (..., 64), limbs <= 2^29
+    u = jnp.pad(u, [(0, 0)] * (u.ndim - 1) + [(0, 1)]) + t
+    u = _fold(u, rounds=3, grow=True)  # (..., 66), limbs <= 4096
+    k = jnp.any(u[..., :NLIMBS] != 0, axis=-1).astype(DTYPE)
+    r = u[..., NLIMBS:].at[..., 0].add(k)
+    # r value < 2^384 + p + 1 -> wrap passes normalize under 2^384
+    return _wrap(_fold(r, rounds=1, grow=False), passes=2)
+
+
+def mont_sqr(a: jnp.ndarray) -> jnp.ndarray:
+    return mont_mul(a, a)
+
+
+def to_mont(a: jnp.ndarray) -> jnp.ndarray:
+    return mont_mul(a, jnp.asarray(R2))
+
+
+def from_mont(a: jnp.ndarray) -> jnp.ndarray:
+    one = jnp.zeros(NLIMBS, DTYPE).at[0].set(1)
+    return mont_mul(a, jnp.broadcast_to(one, a.shape))
+
+
+def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Element-wise limb select; cond has the batch shape (no limb dim)."""
+    return jnp.where(cond[..., None], a, b)
+
+
+# ---------------------------------------------------------------------------
+# Exact normalization and zero test
+# ---------------------------------------------------------------------------
+
+def exact_normalize(t: jnp.ndarray) -> jnp.ndarray:
+    """Sequential carry propagation -> limbs exactly in [0, MASK], plus one
+    carry-out limb: shape (..., NLIMBS+1). Used only at equality checks."""
+
+    def step(carry, x):
+        s = x + carry
+        return s >> BITS, s & MASK
+
+    carry0 = jnp.zeros(t.shape[:-1], dtype=DTYPE)
+    # scan over the limb axis (move it to front)
+    xs = jnp.moveaxis(t, -1, 0)
+    carry, ys = jax.lax.scan(step, carry0, xs)
+    out = jnp.moveaxis(ys, 0, -1)
+    return jnp.concatenate([out, carry[..., None]], axis=-1)
+
+
+def is_zero_mod_p(a: jnp.ndarray) -> jnp.ndarray:
+    """True where the value ≡ 0 (mod p). Sound for any value < ~2^384(1+eps):
+    exact-normalize, then compare against every multiple of p in range."""
+    norm = exact_normalize(a)  # (..., 33)
+    mults = jnp.asarray(_P_MULTIPLES)  # (10, 33)
+    eq = jnp.all(norm[..., None, :] == mults, axis=-1)  # (..., 10)
+    return jnp.any(eq, axis=-1)
+
+
+def eq_mod_p(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return is_zero_mod_p(sub(a, b))
+
+
+# ---------------------------------------------------------------------------
+# Fixed-exponent powering (device, scanned over a host-fixed bit pattern)
+# ---------------------------------------------------------------------------
+
+def pow_const(a: jnp.ndarray, e: int) -> jnp.ndarray:
+    """a^e for a fixed non-negative exponent, LSB-first square-and-multiply
+    under lax.scan (compact trace for ~381-bit exponents)."""
+    if e < 0:
+        raise ValueError("negative exponent (use inverse)")
+    if e == 0:
+        return jnp.broadcast_to(jnp.asarray(ONE_MONT), a.shape)
+    bits = np.array([(e >> i) & 1 for i in range(e.bit_length())], dtype=np.int32)
+
+    def step(state, bit):
+        result, base = state
+        result = select(bit.astype(bool), mont_mul(result, base), result)
+        base = mont_sqr(base)
+        return (result, base), None
+
+    init = (jnp.broadcast_to(jnp.asarray(ONE_MONT), a.shape), a)
+    (result, _), _ = jax.lax.scan(step, init, jnp.asarray(bits))
+    return result
+
+
+def inv(a: jnp.ndarray) -> jnp.ndarray:
+    """Fermat inverse a^(p-2). Stays in Montgomery form. inv(0) = 0."""
+    return pow_const(a, P - 2)

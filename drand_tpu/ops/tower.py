@@ -1,0 +1,355 @@
+"""Batched BLS12-381 extension towers on device.
+
+Layout (trailing dims; any leading dims are batch):
+    Fp   (..., 32)           — limb.py
+    Fp2  (..., 2, 32)        — c0 + c1*u,  u^2 = -1
+    Fp6  (..., 3, 2, 32)     — c0 + c1*v + c2*v^2,  v^3 = xi = 1+u
+    Fp12 (..., 2, 3, 2, 32)  — c0 + c1*w,  w^2 = v
+
+Formulas mirror the host reference drand_tpu.crypto.fields (golden-tested
+against it); everything is Montgomery-domain and batch-broadcasting.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..crypto import fields as hf
+from . import limb
+from .limb import NLIMBS
+
+# ---------------------------------------------------------------------------
+# Host<->device conversion
+# ---------------------------------------------------------------------------
+
+def fp2_to_device(x: hf.Fp2) -> jnp.ndarray:
+    return jnp.stack([limb.fp_to_device(x.c0), limb.fp_to_device(x.c1)], axis=-2)
+
+
+def fp2_from_device(a) -> hf.Fp2:
+    return hf.Fp2(limb.fp_from_device(a[..., 0, :]), limb.fp_from_device(a[..., 1, :]))
+
+
+def fp12_to_device(x: hf.Fp12) -> jnp.ndarray:
+    c = [
+        jnp.stack([fp2_to_device(f6.c0), fp2_to_device(f6.c1), fp2_to_device(f6.c2)],
+                  axis=-3)
+        for f6 in (x.c0, x.c1)
+    ]
+    return jnp.stack(c, axis=-4)
+
+
+def fp12_from_device(a) -> hf.Fp12:
+    def f6(b):
+        return hf.Fp6(fp2_from_device(b[0]), fp2_from_device(b[1]), fp2_from_device(b[2]))
+
+    return hf.Fp12(f6(np.asarray(a)[0]), f6(np.asarray(a)[1]))
+
+
+# ---------------------------------------------------------------------------
+# Fp2
+# ---------------------------------------------------------------------------
+
+def f2(c0: jnp.ndarray, c1: jnp.ndarray) -> jnp.ndarray:
+    return jnp.stack([c0, c1], axis=-2)
+
+
+def f2_zero(batch_shape=()) -> jnp.ndarray:
+    return jnp.zeros(batch_shape + (2, NLIMBS), limb.DTYPE)
+
+
+def f2_one(batch_shape=()) -> jnp.ndarray:
+    one = jnp.asarray(limb.ONE_MONT)
+    return f2(jnp.broadcast_to(one, batch_shape + (NLIMBS,)),
+              jnp.zeros(batch_shape + (NLIMBS,), limb.DTYPE))
+
+
+def f2_add(a, b):
+    return limb.reduce_limbs(a + b)
+
+
+def f2_sub(a, b):
+    return limb.sub(a, b)
+
+
+def f2_neg(a):
+    return limb.neg(a)
+
+
+def f2_mul(a, b):
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    b0, b1 = b[..., 0, :], b[..., 1, :]
+    # Karatsuba: stack the three products into one mont_mul
+    pa = jnp.stack([a0, a1, limb.add(a0, a1)], axis=-2)
+    pb = jnp.stack([b0, b1, limb.add(b0, b1)], axis=-2)
+    v = limb.mont_mul(pa, pb)
+    v0, v1, v2 = v[..., 0, :], v[..., 1, :], v[..., 2, :]
+    return f2(limb.sub(v0, v1), limb.sub(v2, limb.add(v0, v1)))
+
+
+def f2_sqr(a):
+    # (a+bu)^2 = (a+b)(a-b) + 2ab u
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    pa = jnp.stack([limb.add(a0, a1), a0], axis=-2)
+    pb = jnp.stack([limb.sub(a0, a1), a1], axis=-2)
+    v = limb.mont_mul(pa, pb)
+    return f2(v[..., 0, :], limb.double(v[..., 1, :]))
+
+
+def f2_mul_fp(a, s):
+    """Fp2 * Fp (s has shape (..., 32))."""
+    return limb.mont_mul(a, s[..., None, :])
+
+
+def f2_mul_small(a, k: int):
+    return limb.mul_small(a, k)
+
+
+def f2_conj(a):
+    return f2(a[..., 0, :], limb.neg(a[..., 1, :]))
+
+
+def f2_mul_by_xi(a):
+    """Multiply by xi = 1+u: (c0 - c1) + (c0 + c1) u."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    return f2(limb.sub(a0, a1), limb.add(a0, a1))
+
+
+def f2_inv(a):
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    sq = limb.mont_mul(jnp.stack([a0, a1], axis=-2), jnp.stack([a0, a1], axis=-2))
+    norm = limb.add(sq[..., 0, :], sq[..., 1, :])
+    t = limb.inv(norm)
+    return f2(limb.mont_mul(a0, t), limb.neg(limb.mont_mul(a1, t)))
+
+
+def f2_select(cond, a, b):
+    return jnp.where(cond[..., None, None], a, b)
+
+
+def f2_is_zero(a):
+    return limb.is_zero_mod_p(a[..., 0, :]) & limb.is_zero_mod_p(a[..., 1, :])
+
+
+def f2_eq(a, b):
+    return f2_is_zero(f2_sub(a, b))
+
+
+def f2_pow_const(a, e: int):
+    """a^e for fixed e, LSB-first scan."""
+    if e == 0:
+        return jnp.broadcast_to(f2_one(), a.shape)
+    bits = np.array([(e >> i) & 1 for i in range(e.bit_length())], dtype=np.int32)
+
+    def step(state, bit):
+        result, base = state
+        result = f2_select(jnp.broadcast_to(bit.astype(bool), result.shape[:-2]),
+                           f2_mul(result, base), result)
+        return (result, f2_sqr(base)), None
+
+    (result, _), _ = jax.lax.scan(step, (jnp.broadcast_to(f2_one(), a.shape), a),
+                                  jnp.asarray(bits))
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Fp6 (over Fp2, v^3 = xi)
+# ---------------------------------------------------------------------------
+
+def f6(c0, c1, c2):
+    return jnp.stack([c0, c1, c2], axis=-3)
+
+
+def f6_add(a, b):
+    return limb.reduce_limbs(a + b)
+
+
+def f6_sub(a, b):
+    return limb.sub(a, b)
+
+
+def f6_neg(a):
+    return limb.neg(a)
+
+
+def f6_mul(a, b):
+    a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
+    b0, b1, b2 = b[..., 0, :, :], b[..., 1, :, :], b[..., 2, :, :]
+    # Toom-style: 6 Fp2 mults, stacked into two mont batches via f2_mul's
+    # internal stacking (call f2_mul on stacked operands).
+    pa = jnp.stack([a0, a1, a2,
+                    f2_add(a1, a2), f2_add(a0, a1), f2_add(a0, a2)], axis=-3)
+    pb = jnp.stack([b0, b1, b2,
+                    f2_add(b1, b2), f2_add(b0, b1), f2_add(b0, b2)], axis=-3)
+    v = f2_mul(pa, pb)
+    v0, v1, v2 = v[..., 0, :, :], v[..., 1, :, :], v[..., 2, :, :]
+    m12, m01, m02 = v[..., 3, :, :], v[..., 4, :, :], v[..., 5, :, :]
+    c0 = f2_add(v0, f2_mul_by_xi(f2_sub(m12, f2_add(v1, v2))))
+    c1 = f2_add(f2_sub(m01, f2_add(v0, v1)), f2_mul_by_xi(v2))
+    c2 = f2_add(f2_sub(m02, f2_add(v0, v2)), v1)
+    return f6(c0, c1, c2)
+
+
+def f6_sqr(a):
+    return f6_mul(a, a)
+
+
+def f6_mul_fp2(a, k):
+    """Fp6 * Fp2 scalar (k shape (..., 2, 32))."""
+    return f2_mul(a, k[..., None, :, :])
+
+
+def f6_mul_by_v(a):
+    a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
+    return f6(f2_mul_by_xi(a2), a0, a1)
+
+
+def f6_inv(a):
+    a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
+    t0 = f2_sub(f2_sqr(a0), f2_mul_by_xi(f2_mul(a1, a2)))
+    t1 = f2_sub(f2_mul_by_xi(f2_sqr(a2)), f2_mul(a0, a1))
+    t2 = f2_sub(f2_sqr(a1), f2_mul(a0, a2))
+    denom = f2_add(f2_mul(a0, t0),
+                   f2_add(f2_mul_by_xi(f2_mul(a2, t1)),
+                          f2_mul_by_xi(f2_mul(a1, t2))))
+    dinv = f2_inv(denom)
+    return f6(f2_mul(t0, dinv), f2_mul(t1, dinv), f2_mul(t2, dinv))
+
+
+# ---------------------------------------------------------------------------
+# Fp12 (over Fp6, w^2 = v)
+# ---------------------------------------------------------------------------
+
+def f12(c0, c1):
+    return jnp.stack([c0, c1], axis=-4)
+
+
+def f12_one(batch_shape=()) -> jnp.ndarray:
+    out = jnp.zeros(batch_shape + (2, 3, 2, NLIMBS), limb.DTYPE)
+    return out.at[..., 0, 0, 0, :].set(jnp.asarray(limb.ONE_MONT))
+
+
+def f12_mul(a, b):
+    a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
+    b0, b1 = b[..., 0, :, :, :], b[..., 1, :, :, :]
+    pa = jnp.stack([a0, a1, f6_add(a0, a1)], axis=-4)
+    pb = jnp.stack([b0, b1, f6_add(b0, b1)], axis=-4)
+    v = f6_mul(pa, pb)
+    v0, v1, v2 = v[..., 0, :, :, :], v[..., 1, :, :, :], v[..., 2, :, :, :]
+    return f12(f6_add(v0, f6_mul_by_v(v1)), f6_sub(v2, f6_add(v0, v1)))
+
+
+def f12_sqr(a):
+    a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
+    v0 = f6_mul(a0, a1)
+    c0 = f6_sub(f6_mul(f6_add(a0, a1), f6_add(a0, f6_mul_by_v(a1))),
+                f6_add(v0, f6_mul_by_v(v0)))
+    return f12(c0, f6_add(v0, v0))
+
+
+def f12_conj(a):
+    return f12(a[..., 0, :, :, :], f6_neg(a[..., 1, :, :, :]))
+
+
+def f12_inv(a):
+    a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
+    denom = f6_sub(f6_sqr(a0), f6_mul_by_v(f6_sqr(a1)))
+    dinv = f6_inv(denom)
+    return f12(f6_mul(a0, dinv), f6_neg(f6_mul(a1, dinv)))
+
+
+def f12_select(cond, a, b):
+    return jnp.where(cond[..., None, None, None, None], a, b)
+
+
+def f12_is_one(a):
+    d = limb.sub(a, f12_one())
+    z = limb.is_zero_mod_p(d)  # (..., 2, 3, 2)
+    return jnp.all(z, axis=(-3, -2, -1))
+
+
+# -- w-basis (coefficients of w^0..w^5 over Fp2) ----------------------------
+
+def f12_to_w(a) -> jnp.ndarray:
+    """(..., 2, 3, 2, 32) -> (..., 6, 2, 32) in w-power order."""
+    c0, c1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
+    return jnp.stack([
+        c0[..., 0, :, :], c1[..., 0, :, :], c0[..., 1, :, :],
+        c1[..., 1, :, :], c0[..., 2, :, :], c1[..., 2, :, :],
+    ], axis=-3)
+
+
+def f12_from_w(w) -> jnp.ndarray:
+    c0 = jnp.stack([w[..., 0, :, :], w[..., 2, :, :], w[..., 4, :, :]], axis=-3)
+    c1 = jnp.stack([w[..., 1, :, :], w[..., 3, :, :], w[..., 5, :, :]], axis=-3)
+    return f12(c0, c1)
+
+
+# -- Frobenius --------------------------------------------------------------
+
+_GAMMA_DEV = {
+    k: np.stack([
+        np.stack([limb.int_to_limbs(g.c0 * limb.R_MONT % hf.P),
+                  limb.int_to_limbs(g.c1 * limb.R_MONT % hf.P)])
+        for g in hf._FROBENIUS_GAMMA[k]
+    ])
+    for k in (1, 2, 3)
+}
+
+
+def f12_frobenius(a, power: int = 1):
+    """x -> x^(p^power), power in {1, 2, 3}."""
+    w = f12_to_w(a)
+    if power % 2 == 1:
+        w = f2_conj(w)
+    gam = jnp.asarray(_GAMMA_DEV[power])  # (6, 2, 32)
+    return f12_from_w(f2_mul(w, gam))
+
+
+# -- cyclotomic subgroup ops ------------------------------------------------
+
+def f12_cyclotomic_sqr(a):
+    """Granger-Scott squaring (mirrors fields.Fp12.cyclotomic_square)."""
+    w = f12_to_w(a)
+    g = [w[..., i, :, :] for i in range(6)]
+
+    def sq2(x, y):
+        t0 = f2_sqr(x)
+        t1 = f2_sqr(y)
+        return f2_add(t0, f2_mul_by_xi(t1)), f2_sub(f2_sqr(f2_add(x, y)),
+                                                    f2_add(t0, t1))
+
+    a0, a1 = sq2(g[0], g[3])
+    b0, b1 = sq2(g[1], g[4])
+    c0, c1 = sq2(g[2], g[5])
+
+    def fmi(goal, t):  # 3t - 2*goal
+        return f2_add(f2_mul_small(f2_sub(t, goal), 2), t)
+
+    def gpl(goal, t):  # 3t + 2*goal
+        return f2_add(f2_mul_small(f2_add(t, goal), 2), t)
+
+    h = [fmi(g[0], a0), gpl(g[1], f2_mul_by_xi(c1)), fmi(g[2], b0),
+         gpl(g[3], a1), fmi(g[4], c0), gpl(g[5], b1)]
+    return f12_from_w(jnp.stack(h, axis=-3))
+
+
+def f12_cyc_pow_const(a, e: int):
+    """a^e in the cyclotomic subgroup for fixed e (negative -> conjugate)."""
+    if e < 0:
+        return f12_cyc_pow_const(f12_conj(a), -e)
+    if e == 0:
+        return jnp.broadcast_to(f12_one(), a.shape)
+    bits = np.array([(e >> i) & 1 for i in range(e.bit_length())], dtype=np.int32)
+
+    def step(state, bit):
+        result, base = state
+        cond = jnp.broadcast_to(bit.astype(bool), result.shape[:-4])
+        result = f12_select(cond, f12_mul(result, base), result)
+        return (result, f12_cyclotomic_sqr(base)), None
+
+    (result, _), _ = jax.lax.scan(
+        step, (jnp.broadcast_to(f12_one(), a.shape), a), jnp.asarray(bits))
+    return result
