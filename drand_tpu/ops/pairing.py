@@ -10,15 +10,16 @@ Design:
 - Lines are denominator-eliminated (scaled by Fp2 factors, which the final
   exponentiation kills), so the Miller loop is inversion-free: T is tracked
   in Jacobian coordinates on the twist.
-- The Miller loop over |x| is SEGMENTED: runs of doubling bits are
-  `lax.scan`s, the 5 addition bits are unrolled — no wasted conditional
-  add-work per iteration, compact trace.
+- The Miller loop over |x| is ONE `lax.scan` (compile-time critical: a
+  single traced body); the rare addition steps run under `lax.cond`, so
+  only the ~6 set bits of |x| pay for the mixed addition.
 - Sparse line multiplication: the line has w-coefficients only at slots
-  {0, 1, 3} (D-twist untwist: lambda*w, x-terms at w^3), one stacked
-  Fp2-multiply per application.
+  {0, 3, 5} (M-twist untwist (x,y) -> (xi^-1 x w^4, xi^-1 y w^3)), one
+  stacked Fp2-multiply per application.
 - Final exponentiation = easy part + Hayashida chain (cube of the canonical
-  pairing; equality checks are cube-invariant). `canonical=True` corrects by
-  3^-1 mod r for GT interop (timelock IBE).
+  pairing; equality checks are cube-invariant), with the five pow-by-x
+  stages fused into a single scan over a (bit, boundary, segment) schedule.
+  `canonical=True` corrects by 3^-1 mod r for GT interop (timelock IBE).
 
 Host golden reference: drand_tpu.crypto.pairing.
 """
@@ -61,37 +62,40 @@ def g2_affine_to_device(q: PointG2) -> jnp.ndarray:
 # (xp, yp) each (..., npairs, 32); q_aff = (..., npairs, 2, 2, 32).
 # ---------------------------------------------------------------------------
 
-def _sparse_mul_013(f, c0, c1, c3, npairs: int):
-    """f * L for lines L = c0 + c1*w + c3*w^3 (per pair), folding the pair
-    axis: multiplies all npairs lines into f sequentially."""
+def _sparse_mul_035(f, c0, c3, c5, npairs: int):
+    """f * L for lines L = c0 + c3*w^3 + c5*w^5 (per pair), folding the pair
+    axis: multiplies all npairs lines into f sequentially.
+
+    Slots {0, 3, 5} come from the M-twist untwist (x, y) -> (xi^-1 x w^4,
+    xi^-1 y w^3): the y_p term sits at w^0, the x_p (slope) term at w^5, and
+    the twist-coordinate constant at w^3 (overall line scaled by xi * H*Z or
+    xi * 2YZ^3, an Fp2 factor the final exponentiation kills)."""
     for j in range(npairs):
         fw = f12_to_w(f)  # (..., 6, 2, 32)
-        cj = jnp.stack([c0[..., j, :, :], c1[..., j, :, :], c3[..., j, :, :]],
+        cj = jnp.stack([c0[..., j, :, :], c3[..., j, :, :], c5[..., j, :, :]],
                        axis=-3)
         # products p[m, i] = fw_i * c_m : (..., 3, 6, 2, 32)
         prod = f2_mul(fw[..., None, :, :, :], cj[..., :, None, :, :])
-        p0, p1, p3 = prod[..., 0, :, :, :], prod[..., 1, :, :, :], prod[..., 2, :, :, :]
+        p0, p3, p5 = prod[..., 0, :, :, :], prod[..., 1, :, :, :], prod[..., 2, :, :, :]
         out = []
         for k in range(6):
             term = p0[..., k, :, :]
-            i1 = (k - 1) % 6
-            t1 = p1[..., i1, :, :]
-            if k - 1 < 0:
-                t1 = f2_mul_by_xi(t1)
-            i3 = (k - 3) % 6
-            t3 = p3[..., i3, :, :]
+            t3 = p3[..., (k - 3) % 6, :, :]
             if k - 3 < 0:
                 t3 = f2_mul_by_xi(t3)
-            out.append(limb.reduce_limbs(term + t1 + t3))
+            t5 = p5[..., (k - 5) % 6, :, :]
+            if k - 5 < 0:
+                t5 = f2_mul_by_xi(t5)
+            out.append(limb.reduce_light(term + t3 + t5))
         f = f12_from_w(jnp.stack(out, axis=-3))
     return f
 
 
 def _dbl_step(T, p_aff):
-    """Doubling step: new T = 2T and line coefficients (c0, c1, c3).
+    """Doubling step: new T = 2T and line coefficients (c0, c3, c5).
 
-    Line (scaled by 2YZ^3, an Fp2 factor the final exp kills):
-        c0 = 2YZ^3 * yp,  c1 = -3X^2Z^2 * xp,  c3 = 3X^3 - 2Y^2
+    Line (scaled by xi * 2YZ^3, an Fp2 factor the final exp kills):
+        c0 = xi * 2YZ^3 * yp,  c3 = 3X^3 - 2Y^2,  c5 = -3X^2Z^2 * xp
     T-update (Jacobian, a=0): standard doubling.
     """
     X, Y, Z = T
@@ -102,8 +106,8 @@ def _dbl_step(T, p_aff):
     Z3 = f2_mul(Z2, Z)
     YZ3 = f2_mul(Y, Z3)
     lam_s = f2_mul_small(f2_mul(X2, Z2), 3)      # 3 X^2 Z^2
-    c0 = f2_mul_fp(f2_mul_small(YZ3, 2), yp)
-    c1 = f2_neg(f2_mul_fp(lam_s, xp))
+    c0 = f2_mul_by_xi(f2_mul_fp(f2_mul_small(YZ3, 2), yp))
+    c5 = f2_neg(f2_mul_fp(lam_s, xp))
     X3cu = f2_mul(X2, X)
     c3 = f2_sub(f2_mul_small(X3cu, 3), f2_mul_small(Y2, 2))
     # point doubling
@@ -114,14 +118,14 @@ def _dbl_step(T, p_aff):
     Xn = f2_sub(F, f2_mul_small(D, 2))
     Yn = f2_sub(f2_mul(E, f2_sub(D, Xn)), f2_mul_small(C, 8))
     Zn = f2_mul_small(f2_mul(Y, Z), 2)
-    return (Xn, Yn, Zn), (c0, c1, c3)
+    return (Xn, Yn, Zn), (c0, c3, c5)
 
 
 def _add_step(T, q_aff, p_aff):
     """Mixed addition step T <- T + Q and line coefficients.
 
     H = xq Z^2 - X, M = yq Z^3 - Y (scaled slope numerator). Line scaled by
-    H*Z: c0 = HZ*yp, c1 = -M*xp, c3 = M*xq - HZ*yq.
+    xi * H*Z: c0 = xi*HZ*yp, c3 = M*xq - HZ*yq, c5 = -M*xp.
     """
     X, Y, Z = T
     xq, yq = q_aff[..., 0, :, :], q_aff[..., 1, :, :]
@@ -133,8 +137,8 @@ def _add_step(T, q_aff, p_aff):
     H = f2_sub(U2, X)
     M = f2_sub(S2, Y)
     HZ = f2_mul(H, Z)
-    c0 = f2_mul_fp(HZ, yp)
-    c1 = f2_neg(f2_mul_fp(M, xp))
+    c0 = f2_mul_by_xi(f2_mul_fp(HZ, yp))
+    c5 = f2_neg(f2_mul_fp(M, xp))
     c3 = f2_sub(f2_mul(M, xq), f2_mul(HZ, yq))
     # point update
     HH = f2_sqr(H)
@@ -144,27 +148,20 @@ def _add_step(T, q_aff, p_aff):
     Xn = f2_sub(M2, f2_add(HHH, f2_mul_small(V, 2)))
     Yn = f2_sub(f2_mul(M, f2_sub(V, Xn)), f2_mul(Y, HHH))
     Zn = f2_mul(Z, H)
-    return (Xn, Yn, Zn), (c0, c1, c3)
+    return (Xn, Yn, Zn), (c0, c3, c5)
 
 
-# Bit schedule of |x| (MSB implicit): segments of doubling-only runs split by
-# the addition bits.
+# Bit schedule of |x| (MSB implicit): one scan iteration per bit; a '1' bit
+# additionally performs the mixed-addition step (under lax.cond — the
+# predicate is a scalar per step, so only ~6 of 63 iterations pay for it).
 _X_ABS = abs(X_BLS)
 _BITS_MSB = bin(_X_ABS)[3:]  # after the implicit leading 1
-# parse: each char is one iteration (sqr+dbl); '1' additionally does an add.
-_runs: list[tuple[int, bool]] = []
-_count = 0
-for _ch in _BITS_MSB:
-    _count += 1
-    if _ch == "1":
-        _runs.append((_count, True))
-        _count = 0
-if _count:
-    _runs.append((_count, False))
+_MILLER_BITS = np.array([int(_ch) for _ch in _BITS_MSB], dtype=np.int32)
 
 
 def miller_loop(p_affs, q_affs):
-    """Batched shared-squaring Miller loop.
+    """Batched shared-squaring Miller loop — a single lax.scan over the bits
+    of |x| (compile-time critical: one traced body, 63 iterations).
 
     p_affs: tuple (xp, yp) arrays shaped (..., npairs, 32), mont domain.
     q_affs: (..., npairs, 2, 2, 32) affine twist points, mont domain.
@@ -177,43 +174,102 @@ def miller_loop(p_affs, q_affs):
     batch_shape = q_affs.shape[:-4]
     f = jnp.broadcast_to(f12_one(), batch_shape + (2, 3, 2, limb.NLIMBS))
 
-    def dbl_body(state, _):
+    def add_part(state):
+        f, T = state
+        T, (c0, c3, c5) = _add_step(T, q_affs, p_affs)
+        f = _sparse_mul_035(f, c0, c3, c5, npairs)
+        return (f, T)
+
+    def body(state, bit):
         f, T = state
         f = f12_sqr(f)
-        T, (c0, c1, c3) = _dbl_step(T, p_affs)
-        f = _sparse_mul_013(f, c0, c1, c3, npairs)
-        return (f, T), None
+        T, (c0, c3, c5) = _dbl_step(T, p_affs)
+        f = _sparse_mul_035(f, c0, c3, c5, npairs)
+        state = jax.lax.cond(bit.astype(bool), add_part, lambda s: s, (f, T))
+        return state, None
 
-    state = (f, T)
-    for run_len, has_add in _runs:
-        state, _ = jax.lax.scan(dbl_body, state, None, length=run_len)
-        if has_add:
-            f, T = state
-            T, (c0, c1, c3) = _add_step(T, q_affs, p_affs)
-            f = _sparse_mul_013(f, c0, c1, c3, npairs)
-            state = (f, T)
-    f, T = state
+    (f, T), _ = jax.lax.scan(body, (f, T), jnp.asarray(_MILLER_BITS))
     return f12_conj(f)  # x < 0
 
 
 # ---------------------------------------------------------------------------
-# Final exponentiation (mirrors crypto/pairing.py final_exponentiation)
+# Final exponentiation (mirrors crypto/pairing.py final_exponentiation).
+#
+# The Hayashida hard part is FIVE pow-by-(~x) chains; tracing five separate
+# scans quintuples compile time, so the whole chain runs as ONE lax.scan over
+# a (bit, boundary, segment) schedule. Each step is a MSB-first pow step
+# (acc <- acc^2; acc <- acc*base if bit); at the 5 segment boundaries a
+# lax.switch applies the inter-pow glue (frobenius multiplies, base/acc
+# reload). Registers: acc, base, keep (holds a2 then a3).
+#
+#   seg0: a1 = m^(x-1)            = pow(conj(m), |x-1|)          [x < 0]
+#   seg1: a2 = a1^(x-1)
+#   seg2: a3 = a2^x * frob1(a2)
+#   seg3: t  = a3^x
+#   seg4: a4 = t^x * frob2(a3) * conj(a3)
+#   out: cubed = a4 * m^3  (host: a * m * cyclotomic_square(m))
 # ---------------------------------------------------------------------------
 
 _INV3_MOD_R = pow(3, -1, R)
+
+_SEG_LEN = 64  # covers |x-1| and |x| (both 64-bit)
+
+
+def _msb_bits(e: int, width: int) -> np.ndarray:
+    return np.array([(e >> (width - 1 - i)) & 1 for i in range(width)],
+                    dtype=np.int32)
+
+
+_HARD_EXPS = [abs(X_BLS - 1), abs(X_BLS - 1), abs(X_BLS), abs(X_BLS), abs(X_BLS)]
+_HARD_BITS = np.concatenate([_msb_bits(e, _SEG_LEN) for e in _HARD_EXPS])
+_HARD_BOUNDARY = np.zeros(5 * _SEG_LEN, dtype=np.int32)
+_HARD_BOUNDARY[_SEG_LEN - 1 :: _SEG_LEN] = 1
+_HARD_SEG = np.repeat(np.arange(5, dtype=np.int32), _SEG_LEN)
+
+
+def _hard_part(m):
+    """m^(hard exponent) for cyclotomic m — single-scan Hayashida chain."""
+    one = jnp.broadcast_to(f12_one(), m.shape)
+
+    def glue0(r, keep):  # also seg3
+        return one, f12_conj(r), keep
+    def glue1(r, keep):
+        return one, f12_conj(r), r
+    def glue2(r, keep):
+        rr = f12_mul(r, f12_frobenius(keep, 1))
+        return one, f12_conj(rr), rr
+    def glue4(r, keep):
+        out = f12_mul(f12_mul(r, f12_frobenius(keep, 2)), f12_conj(keep))
+        return out, f12_conj(r), keep
+
+    def body(state, x):
+        bit, boundary, seg = x
+        acc, base, keep = state
+        acc = f12_cyclotomic_sqr(acc)
+        acc = tower.f12_select(
+            jnp.broadcast_to(bit.astype(bool), acc.shape[:-4]),
+            f12_mul(acc, base), acc)
+
+        def at_boundary(s):
+            acc, base, keep = s
+            return jax.lax.switch(
+                seg, [glue0, glue1, glue2, glue0, glue4], acc, keep)
+
+        state = jax.lax.cond(boundary.astype(bool), at_boundary, lambda s: s,
+                             (acc, base, keep))
+        return state, None
+
+    xs = (jnp.asarray(_HARD_BITS), jnp.asarray(_HARD_BOUNDARY),
+          jnp.asarray(_HARD_SEG))
+    (acc, _, _), _ = jax.lax.scan(body, (one, f12_conj(m), m), xs)
+    return acc
 
 
 def final_exponentiation(f, canonical: bool = False):
     f1 = f12_mul(f12_conj(f), f12_inv(f))
     m = f12_mul(f12_frobenius(f1, 2), f1)
-    a = f12_cyc_pow_const(m, X_BLS - 1)
-    a = f12_cyc_pow_const(a, X_BLS - 1)
-    a = f12_mul(f12_cyc_pow_const(a, X_BLS), f12_frobenius(a, 1))
-    a = f12_mul(
-        f12_cyc_pow_const(f12_cyc_pow_const(a, X_BLS), X_BLS),
-        f12_mul(f12_frobenius(a, 2), f12_conj(a)),
-    )
-    cubed = f12_mul(a, f12_mul(m, f12_cyclotomic_sqr(m)))
+    a4 = _hard_part(m)
+    cubed = f12_mul(a4, f12_mul(m, f12_cyclotomic_sqr(m)))
     if canonical:
         return f12_cyc_pow_const(cubed, _INV3_MOD_R)
     return cubed
@@ -238,9 +294,14 @@ _NEG_G1_AFF = None
 
 
 def _neg_g1():
+    # Host-side numpy (no jax ops): safe to call lazily even under jit trace.
     global _NEG_G1_AFF
     if _NEG_G1_AFF is None:
-        _NEG_G1_AFF = np.asarray(g1_affine_to_device(-PointG1.generator()))
+        x, y = (-PointG1.generator()).to_affine()
+        _NEG_G1_AFF = np.stack([
+            limb.int_to_limbs(x.v * limb.R_MONT % P),
+            limb.int_to_limbs(y.v * limb.R_MONT % P),
+        ])
     return jnp.asarray(_NEG_G1_AFF)
 
 

@@ -67,7 +67,7 @@ def f2_one(batch_shape=()) -> jnp.ndarray:
 
 
 def f2_add(a, b):
-    return limb.reduce_limbs(a + b)
+    return limb.reduce_light(a + b)
 
 
 def f2_sub(a, b):
@@ -163,7 +163,7 @@ def f6(c0, c1, c2):
 
 
 def f6_add(a, b):
-    return limb.reduce_limbs(a + b)
+    return limb.reduce_light(a + b)
 
 
 def f6_sub(a, b):

@@ -1,0 +1,27 @@
+"""Persistent XLA compilation cache.
+
+The batched pairing graphs are large (the Miller loop + final-exp scan
+bodies); first compilation costs minutes of XLA time. Enabling JAX's
+persistent compilation cache makes that a once-per-machine cost instead of
+once-per-process — essential for the test suite, bench.py, and the daemon's
+startup latency. Mirrors the role of Go's on-disk build cache for the
+reference (which pays its compile cost at `go build`, not at runtime).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_persistent_cache(path: str | None = None) -> None:
+    """Idempotently point JAX's compilation cache at a writable directory."""
+    import jax
+
+    if path is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        path = os.path.join(root, ".jax_cache")
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
