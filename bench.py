@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Headline benchmark: batched BLS12-381 pairing throughput on one chip.
+
+Measures the device verification graph (ops/pairing.verify_prepared) that
+backs the aggregator's recovered-signature checks and the chain-catchup
+verifier — the reference's crypto hot path (chain/beacon/chain.go:136-141,
+client/verify.go:146-163) executed as one multi-pairing batch.
+
+Each verification is one BLS check e(-g1, sig) * e(pub, H(msg)) == 1,
+i.e. TWO pairings (the reference computes two `Pairing` calls per verify).
+Throughput counts pairings, matching BASELINE.md's north-star metric
+(>= 200,000 pairings/sec on one TPU v5e chip).
+
+Prints exactly ONE JSON line:
+    {"metric": "pairings_per_sec", "value": N, "unit": "pairings/s",
+     "vs_baseline": N / 200000}
+Progress/diagnostics go to stderr. Environment knobs:
+    BENCH_BATCH       comma-separated batch sizes to try, largest first
+                      (default "64,16"); each batch's results are
+                      self-checked against the host truth and a failing
+                      batch size is skipped — the axon TPU backend
+                      currently miscompiles the pairing graph at batches
+                      >= ~64 (see ops/pairing.py docstring), so the
+                      largest CORRECT batch wins
+    BENCH_MIN_SECONDS minimum timed window (default 5.0)
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from drand_tpu.utils.jit_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from drand_tpu.crypto import bls
+    from drand_tpu.crypto.curves import PointG1, PointG2
+    from drand_tpu.crypto.hash_to_curve import hash_to_g2
+    from drand_tpu.ops import limb, pairing
+
+    batches = [int(b) for b in
+               os.environ.get("BENCH_BATCH", "64,16").split(",")]
+    min_seconds = float(os.environ.get("BENCH_MIN_SECONDS", "5.0"))
+    log(f"backend={jax.default_backend()} devices={jax.devices()} "
+        f"batches={batches}")
+
+    # Inputs: a small pool of real (pub, sig, H(msg)) triples tiled to the
+    # batch — content doesn't affect timing (fixed-shape straight-line code),
+    # but they must be valid curve points, and the check must return True.
+    sk = 0x1F3A
+    pub = PointG1.generator().mul(sk)
+    pool = 8
+    from drand_tpu.ops.engine import _g1_aff, _g2_aff
+
+    pub_aff = _g1_aff(pub)
+    t_prep = time.perf_counter()
+    pool_sigs, pool_msgs = [], []
+    for i in range(pool):
+        msg = b"drand-tpu-bench-round-%d" % i
+        pool_msgs.append(_g2_aff(hash_to_g2(msg)))
+        pool_sigs.append(_g2_aff(
+            PointG2.from_bytes(bls.sign(sk, msg), subgroup_check=False)))
+    log(f"host prep: {time.perf_counter() - t_prep:.1f}s")
+    verify = jax.jit(pairing.verify_prepared)
+
+    rate = None
+    for batch in batches:
+        pubs = np.broadcast_to(pub_aff, (batch, 2, limb.NLIMBS))
+        sigs = np.stack([pool_sigs[i % pool] for i in range(batch)])
+        msgs = np.stack([pool_msgs[i % pool] for i in range(batch)])
+        pubs_d, sigs_d, msgs_d = (jnp.asarray(pubs), jnp.asarray(sigs),
+                                  jnp.asarray(msgs))
+        t0 = time.perf_counter()
+        out = np.asarray(verify(pubs_d, sigs_d, msgs_d))
+        log(f"batch {batch}: first call (compile+run) "
+            f"{time.perf_counter() - t0:.1f}s")
+        if not out.all():
+            log(f"batch {batch}: verification returned False on valid "
+                f"inputs (known axon large-batch miscompile) — skipping")
+            continue
+        calls = 0
+        t0 = time.perf_counter()
+        deadline = t0 + min_seconds
+        while time.perf_counter() < deadline or calls < 3:
+            verify(pubs_d, sigs_d, msgs_d).block_until_ready()
+            calls += 1
+        dt = time.perf_counter() - t0
+        rate = 2 * batch * calls / dt
+        log(f"{calls} calls x {batch} verifications in {dt:.2f}s "
+            f"({dt / calls * 1e3:.0f} ms/call, {rate:.0f} pairings/s)")
+        break
+    if rate is None:
+        log("FATAL: no batch size produced correct results")
+        raise SystemExit(1)
+
+    print(json.dumps({
+        "metric": "pairings_per_sec",
+        "value": round(rate, 1),
+        "unit": "pairings/s",
+        "vs_baseline": round(rate / 200000.0, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

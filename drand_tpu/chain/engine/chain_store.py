@@ -12,14 +12,14 @@ import asyncio
 import time as _time
 from dataclasses import dataclass
 
-from ...crypto import tbls
+from ...crypto import batch
 from ...net.packets import PartialBeaconPacket
 from ...net.transport import ProtocolClient
 from ...utils.logging import KVLogger
 from .. import beacon as chain_beacon
 from .. import time_math
 from ..beacon import Beacon
-from ..store import AppendStore, CallbackStore, Store, StoreError
+from ..store import AppendStore, CallbackStore, DiscrepancyStore, Store, StoreError
 from .cache import PartialCache
 from .crypto import CryptoStore
 from .sync import Syncer
@@ -41,7 +41,7 @@ class ChainStore(CallbackStore):
 
     def __init__(self, logger: KVLogger, conf, client: ProtocolClient,
                  crypto: CryptoStore, store: Store, ticker: Ticker):
-        base = AppendStore(store)
+        base = DiscrepancyStore(AppendStore(store), conf.group, conf.clock)
         super().__init__(base)
         self._l = logger
         self._conf = conf
@@ -81,67 +81,85 @@ class ChainStore(CallbackStore):
         cache = PartialCache()
         while True:
             kind, payload = await self._events.get()
-            if kind == "stored":
-                last = payload
-                cache.flush_rounds(last.round)
-                continue
-            partial = payload
-            p_round = partial.p.round
-            if not (last.round < p_round <= last.round + PARTIAL_CACHE_STORE_LIMIT + 1):
-                self._l.debug("aggregator", "ignoring_partial", round=p_round,
-                              last=last.round)
-                continue
-            group = self._crypto.get_group()
-            thr, n = group.threshold, len(group)
-            cache.append(partial.p)
-            rc = cache.get_round_cache(p_round, partial.p.previous_sig)
-            if rc is None:
-                self._l.error("aggregator", "no_round_cache", round=p_round)
-                continue
-            self._l.debug("aggregator", "store_partial", addr=partial.addr,
-                          round=rc.round, have=f"{len(rc)}/{thr}")
-            if len(rc) < thr:
-                continue
-            new_beacon = self._aggregate(rc, thr, n)
-            if new_beacon is None:
-                continue
-            cache.flush_rounds(rc.round)
-            self._l.info("aggregator", "aggregated_beacon", round=new_beacon.round,
-                         v2=new_beacon.is_v2())
-            if self._try_append(last, new_beacon):
-                last = new_beacon
-                continue
-            if new_beacon.round > last.round + 1:
-                # aggregated a beacon ahead of our chain: catch up
-                peers = [nd.identity for nd in group.nodes]
-                asyncio.ensure_future(self.sync.follow(new_beacon.round, peers))
+            try:
+                last = self._process_event(kind, payload, cache, last)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — the aggregator task
+                # must survive any crypto-engine failure (device mode
+                # re-raises instead of falling back): losing this task
+                # silently halts the node
+                self._l.error("aggregator", "event_failed", err=repr(e))
+
+    def _process_event(self, kind: str, payload, cache: PartialCache,
+                       last: Beacon) -> Beacon:
+        if kind == "stored":
+            last = payload
+            cache.flush_rounds(last.round)
+            return last
+        partial = payload
+        p_round = partial.p.round
+        if not (last.round < p_round <= last.round + PARTIAL_CACHE_STORE_LIMIT + 1):
+            self._l.debug("aggregator", "ignoring_partial", round=p_round,
+                          last=last.round)
+            return last
+        group = self._crypto.get_group()
+        thr, n = group.threshold, len(group)
+        cache.append(partial.p)
+        rc = cache.get_round_cache(p_round, partial.p.previous_sig)
+        if rc is None:
+            self._l.error("aggregator", "no_round_cache", round=p_round)
+            return last
+        self._l.debug("aggregator", "store_partial", addr=partial.addr,
+                      round=rc.round, have=f"{len(rc)}/{thr}")
+        if len(rc) < thr:
+            return last
+        new_beacon = self._aggregate(rc, thr, n)
+        if new_beacon is None:
+            return last
+        cache.flush_rounds(rc.round)
+        self._l.info("aggregator", "aggregated_beacon", round=new_beacon.round,
+                     v2=new_beacon.is_v2())
+        if self._try_append(last, new_beacon):
+            return new_beacon
+        if new_beacon.round > last.round + 1:
+            # aggregated a beacon ahead of our chain: catch up
+            peers = [nd.identity for nd in group.nodes]
+            asyncio.ensure_future(self.sync.follow(new_beacon.round, peers))
+        return last
 
     def _aggregate(self, rc, thr: int, n: int) -> Beacon | None:
         """Recover + verify V1 and (when possible) V2 — the crypto hot path
-        (chain/beacon/chain.go:136-166)."""
+        (chain/beacon/chain.go:136-166). Recovery and the final checks go
+        through the batch dispatch (crypto/batch.py): both re-verifications
+        run as ONE device call when the engine is active."""
         pub = self._crypto.get_pub()
         msg = rc.msg()
         try:
-            final_sig = tbls.recover(pub, msg, rc.partials(), thr, n)
+            final_sig = batch.recover(pub, msg, rc.partials(), thr, n)
         except ValueError as e:
             self._l.debug("aggregator", "invalid_recovery", err=str(e), round=rc.round)
             return None
-        if not tbls.verify_recovered(pub.commit(), msg, final_sig):
-            self._l.error("aggregator", "invalid_sig", round=rc.round)
-            return None
         b = Beacon(round=rc.round, previous_sig=rc.prev, signature=final_sig)
+        checks = [(msg, final_sig)]
+        sig_v2 = b""
         if rc.len_v2() >= thr:
             msg_v2 = chain_beacon.message_v2(rc.round)
             try:
-                sig_v2 = tbls.recover(pub, msg_v2, rc.partials_v2(), thr, n)
+                sig_v2 = batch.recover(pub, msg_v2, rc.partials_v2(), thr, n)
             except ValueError as e:
                 self._l.debug("aggregator", "invalid_recovery_v2", err=str(e))
                 return None  # never accept a beacon whose V2 fails to recover
-            if tbls.verify_recovered(pub.commit(), msg_v2, sig_v2):
-                b.signature_v2 = sig_v2
-            else:
+            checks.append((msg_v2, sig_v2))
+        oks = batch.verify_recovered_many(pub.commit(), checks)
+        if not oks[0]:
+            self._l.error("aggregator", "invalid_sig", round=rc.round)
+            return None
+        if sig_v2:
+            if not oks[1]:
                 self._l.error("aggregator", "invalid_sig_v2", round=rc.round)
                 return None
+            b.signature_v2 = sig_v2
         return b
 
     def _try_append(self, last: Beacon, new_beacon: Beacon) -> bool:

@@ -5,16 +5,65 @@ streams (server). Reference: chain/beacon/sync.go.
 from __future__ import annotations
 
 import asyncio
+import os
 import random
 from typing import AsyncIterator
 
+from ...crypto import batch
 from ...net.packets import SyncRequest
 from ...net.transport import ProtocolClient, TransportError
 from ...utils.logging import KVLogger
-from .. import beacon as chain_beacon
 from ..beacon import Beacon
 from ..info import Info
 from ..store import CallbackStore, StoreError
+
+# beacons buffered per batched verification during follow; the device engine
+# verifies a whole chunk in one multi-pairing call (client/verify.go:146-163
+# made parallel). Chunk boundaries never change semantics — only batch size.
+SYNC_CHUNK = int(os.environ.get("DRAND_TPU_SYNC_CHUNK", "64"))
+
+
+async def _chunks(stream: AsyncIterator[Beacon], size: int):
+    """Re-chunk an async stream into lists of up to `size`, flushing early
+    when the producer stalls (so live streams stay per-item latency).
+    On a stream error the partial buffer is flushed before the error
+    propagates (received beacons are not re-fetched from the next peer),
+    and a pending read is cancelled if the consumer exits early."""
+    buf: list[Beacon] = []
+    it = stream.__aiter__()
+    task: asyncio.Future | None = None
+    try:
+        while True:
+            task = asyncio.ensure_future(it.__anext__())
+            # a replaying server yields back-to-back without real awaiting;
+            # give the task a few microtask rounds before declaring a stall
+            for _ in range(4):
+                if task.done():
+                    break
+                await asyncio.sleep(0)
+            if not task.done() and buf:
+                yield buf
+                buf = []
+            try:
+                b = await task
+            except StopAsyncIteration:
+                task = None
+                break
+            except Exception:
+                task = None
+                if buf:
+                    yield buf
+                raise
+            task = None
+            buf.append(b)
+            if len(buf) >= size:
+                yield buf
+                buf = []
+        if buf:
+            yield buf
+    finally:
+        if task is not None and not task.done():
+            task.cancel()
 
 
 class Syncer:
@@ -60,31 +109,36 @@ class Syncer:
             return False
         try:
             stream = self._client.sync_chain(peer, SyncRequest(from_round=last.round + 1))
-            async for b in stream:
-                if not chain_beacon.verify_beacon(self._info.public_key, b):
-                    self._l.warn("syncer", "invalid_beacon", peer=_addr(peer), round=b.round)
-                    return False
-                # V2 must also verify when present: a malicious sync peer must
-                # not be able to poison the unchained signature (the timelock
-                # decryption key). The reference omits this (sync.go:105) —
-                # fixed here.
-                if b.is_v2() and not chain_beacon.verify_beacon_v2(self._info.public_key, b):
-                    self._l.warn("syncer", "invalid_beacon_v2", peer=_addr(peer), round=b.round)
-                    return False
-                try:
-                    self._store.put(b)
-                except StoreError as e:
-                    self._l.debug("syncer", "store_failed", err=str(e))
-                    return False
-                last = b
-                if up_to and last.round >= up_to:
-                    self._l.debug("syncer", "finished", round=up_to)
-                    return True
+            async for chunk in _chunks(stream, SYNC_CHUNK):
+                # batched dual verification: V1 chain link and — hardening
+                # over the reference, which skips this (sync.go:105) — the V2
+                # signature when present, so a malicious sync peer cannot
+                # poison the unchained signature (the timelock key).
+                oks = batch.verify_beacons(self._info.public_key, chunk)
+                for b, ok in zip(chunk, oks):
+                    if not ok:
+                        self._l.warn("syncer", "invalid_beacon", peer=_addr(peer),
+                                     round=b.round)
+                        return False
+                    try:
+                        self._store.put(b)
+                    except StoreError as e:
+                        self._l.debug("syncer", "store_failed", err=str(e))
+                        return False
+                    last = b
+                    if up_to and last.round >= up_to:
+                        self._l.debug("syncer", "finished", round=up_to)
+                        return True
         except TransportError as e:
             self._l.debug("syncer", "unable_to_sync", peer=_addr(peer), err=str(e))
             return False
         except asyncio.CancelledError:
             raise
+        except Exception as e:  # noqa: BLE001 — a crypto-engine failure
+            # (device mode re-raises) must not kill the follow task
+            self._l.error("syncer", "sync_failed", peer=_addr(peer),
+                          err=repr(e))
+            return False
         return False
 
     async def sync_chain(self, from_addr: str, req: SyncRequest) -> AsyncIterator[Beacon]:

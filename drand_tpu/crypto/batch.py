@@ -1,0 +1,152 @@
+"""Batch crypto dispatch: device engine when configured, host otherwise.
+
+Protocol code (the aggregator, the syncer, the verifying client) calls this
+module instead of choosing an implementation — mirroring how the reference
+gates all crypto behind the ``Scheme`` globals (key/curve.go:31), which is
+exactly the boundary BASELINE.json names as the TPU swap point.
+
+Modes (env ``DRAND_TPU_ENGINE`` or :func:`configure`):
+- ``auto`` (default): use the device engine for batches of at least
+  ``min_batch`` items; small/latency-sensitive calls stay on the host
+  (per-round work is a handful of pairings — dispatch overhead would
+  dominate; the device shines on catchup/recovery batches).
+- ``device``: always use the device engine (tests force this).
+- ``host``: never touch the device.
+
+The device engine is created lazily (it imports jax and compiles on first
+use) and any engine failure falls back to the host path — the host
+implementation is the semantics oracle.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from . import tbls
+from .curves import PointG1
+from .hash_to_curve import DEFAULT_DST_G2
+from .poly import PubPoly
+
+_MODE = os.environ.get("DRAND_TPU_ENGINE", "auto")
+_MIN_BATCH = int(os.environ.get("DRAND_TPU_MIN_BATCH", "8"))
+_ENGINE = None
+_FALLBACK_LOGGED = False
+
+
+def _note_fallback(op: str, err: Exception) -> None:
+    """Auto-mode device failures fall back to host silently except for a
+    one-time warning — a persistently broken engine must be visible."""
+    global _FALLBACK_LOGGED
+    from .. import metrics
+
+    metrics.ENGINE_FALLBACKS.inc()
+    if not _FALLBACK_LOGGED:
+        _FALLBACK_LOGGED = True
+        from ..utils.logging import default_logger
+
+        default_logger("batch").warn(
+            "engine", "device_fallback", op=op, err=repr(err))
+
+
+def configure(mode: str, min_batch: int | None = None, engine=None) -> None:
+    """Override the dispatch policy (tests; daemon config)."""
+    global _MODE, _MIN_BATCH, _ENGINE
+    if mode not in ("auto", "device", "host"):
+        raise ValueError(f"unknown engine mode {mode!r}")
+    _MODE = mode
+    if min_batch is not None:
+        _MIN_BATCH = min_batch
+    if engine is not None:
+        _ENGINE = engine
+
+
+def engine():
+    """The lazily-created device engine, or None in host mode."""
+    global _ENGINE
+    if _MODE == "host":
+        return None
+    if _ENGINE is None:
+        from ..ops.engine import BatchedEngine
+
+        _ENGINE = BatchedEngine()
+    return _ENGINE
+
+
+def _use_device(n_items: int) -> bool:
+    if _MODE == "host":
+        return False
+    if _MODE == "device":
+        return True
+    return n_items >= _MIN_BATCH
+
+
+# ---------------------------------------------------------------------------
+# Batched operations (device with host fallback)
+# ---------------------------------------------------------------------------
+
+def verify_beacons(pubkey: PointG1, beacons,
+                   dst: bytes = DEFAULT_DST_G2) -> np.ndarray:
+    """Per-beacon dual (V1 + V2-when-present) verification over a span —
+    the catchup hot path. Returns a bool array aligned with ``beacons``."""
+    from ..chain import beacon as chain_beacon
+
+    if _use_device(len(beacons)):
+        try:
+            return engine().verify_beacons(pubkey, beacons, dst)
+        except Exception as e:  # noqa: BLE001 — host path is the oracle
+            if _MODE == "device":
+                raise
+            _note_fallback("verify_beacons", e)
+    out = np.zeros(len(beacons), dtype=bool)
+    for i, b in enumerate(beacons):
+        ok = chain_beacon.verify_beacon(pubkey, b)
+        if ok and b.is_v2():
+            ok = chain_beacon.verify_beacon_v2(pubkey, b)
+        out[i] = ok
+    return out
+
+
+def verify_partials(pub_poly: PubPoly, msg: bytes, partials,
+                    dst: bytes = DEFAULT_DST_G2) -> list[bool]:
+    """Verify many partials of one round at once (Scheme.VerifyPartial,
+    chain/beacon/node.go:112, batched)."""
+    if _use_device(len(partials)):
+        try:
+            return engine().verify_partials(pub_poly, msg, partials, dst)
+        except Exception as e:  # noqa: BLE001
+            if _MODE == "device":
+                raise
+            _note_fallback("verify_partials", e)
+    return [tbls.verify_partial(pub_poly, msg, p, dst) for p in partials]
+
+
+def verify_recovered_many(pubkey: PointG1, pairs,
+                          dst: bytes = DEFAULT_DST_G2) -> list[bool]:
+    """Batch of (msg, sig) full-signature checks — the aggregator's V1+V2
+    re-verification becomes one call (chain/beacon/chain.go:141,159)."""
+    if _use_device(len(pairs)):
+        try:
+            return engine().verify_sigs(pubkey, pairs, dst)
+        except Exception as e:  # noqa: BLE001
+            if _MODE == "device":
+                raise
+            _note_fallback("verify_recovered_many", e)
+    return [tbls.verify_recovered(pubkey, m, s, dst) for m, s in pairs]
+
+
+def recover(pub_poly: PubPoly, msg: bytes, partials, t: int, n: int,
+            dst: bytes = DEFAULT_DST_G2) -> bytes:
+    """Lagrange recovery of the full signature (Scheme.Recover,
+    chain/beacon/chain.go:136). Device MSM for large thresholds."""
+    if _use_device(t):
+        try:
+            return engine().recover(pub_poly, msg, partials, t, n, dst)
+        except ValueError:
+            raise  # semantic error (not enough partials): no fallback
+        except Exception as e:  # noqa: BLE001
+            if _MODE == "device":
+                raise
+            _note_fallback("recover", e)
+    return tbls.recover(pub_poly, msg, partials, t, n, dst)

@@ -200,12 +200,23 @@ def pt_to_affine(F, p):
 # Scalar multiplication
 # ---------------------------------------------------------------------------
 
+def _pt_infinity_like(F, p, batch_shape):
+    """Infinity with each component arithmetically derived from p's, so the
+    result carries p's varying-manual-axes type under shard_map (a fresh
+    constant as a lax.scan carry fails typechecking in a mapped region)."""
+    zero_tag = jnp.zeros(batch_shape, limb.DTYPE) + (
+        p[0].reshape(p[3].shape + (-1,))[..., 0] * 0)
+    tag = zero_tag[..., None, None] if F.elem_ndim == 2 else zero_tag[..., None]
+    return (F.one(batch_shape) + tag, F.one(batch_shape) + tag,
+            F.zero(batch_shape) + tag, jnp.ones(batch_shape, bool) | (zero_tag != 0))
+
+
 def pt_mul_bits(F, p, bits):
     """Variable-scalar multiplication. bits: (..., nbits) int32, MSB first,
     broadcastable against the point's batch shape. Returns bits ⋅ p."""
     nbits = bits.shape[-1]
     batch_shape = jnp.broadcast_shapes(p[3].shape, bits.shape[:-1])
-    acc = pt_infinity(F, batch_shape)
+    acc = _pt_infinity_like(F, p, batch_shape)
     base = tuple(jnp.broadcast_to(c, batch_shape + c.shape[len(p[3].shape):])
                  for c in p)
 
@@ -264,7 +275,9 @@ def msm(F, points, bits):
     n = points[3].shape[-1]
     nbits = bits.shape[-1]
     batch_shape = points[3].shape[:-1]
-    acc = pt_infinity(F, batch_shape)
+    p0 = tuple(c[..., 0, :, :] if F.elem_ndim == 2 else c[..., 0, :]
+               for c in points[:3]) + (points[3][..., 0],)
+    acc = _pt_infinity_like(F, p0, batch_shape)
 
     def step(acc, bit_col):
         # bit_col: (..., n)

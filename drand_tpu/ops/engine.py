@@ -1,0 +1,238 @@
+"""Batched device crypto engine — the TPU execution path of the Scheme.
+
+This is the component the whole build exists for (BASELINE.json north star):
+the reference's per-round sequential crypto hot calls —
+``Scheme.VerifyPartial`` (chain/beacon/node.go:112), ``Scheme.Recover`` +
+``VerifyRecovered`` (chain/beacon/chain.go:136-141), and the chain-catchup
+verifier (client/verify.go:146-163) — become batched device computations:
+
+- ``verify_bls``: one jitted multi-pairing graph checks a whole tensor of
+  (pubkey, signature, message) triples at once.
+- ``verify_beacons``: dual V1+V2 beacon verification for a span of rounds,
+  flattened into one such tensor.
+- ``verify_partials``: all of a round's partials against their per-index
+  public key shares in one call.
+- ``recover``: Lagrange interpolation of the full signature as a device MSM
+  over the partials (the ``Scheme.Recover`` analogue).
+
+Batch shapes are bucketed (padded up to a small set of sizes) so the number
+of XLA compilations is bounded; compiled executables are reused across
+calls and persisted via the compilation cache (utils/jit_cache.py).
+
+Host-side preparation (SHA-256 message expansion, point decompression,
+hash-to-curve) currently runs on the host reference implementation; the
+engine interface takes wire-format bytes so the prep can migrate on-device
+without touching callers.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..crypto import tbls
+from ..crypto.curves import PointG1, PointG2
+from ..crypto.fields import P, R
+from ..crypto.hash_to_curve import DEFAULT_DST_G2, hash_to_g2
+from ..crypto.poly import PubPoly, PubShare, lagrange_coefficients
+from . import curve, limb, pairing, tower
+
+# Each bucket is one XLA compilation of the pairing graph (minutes on a
+# cold cache) — keep the set small. Batches above the top bucket split
+# into multiple top-bucket calls.
+#
+# Top bucket 16: the axon TPU stack currently returns WRONG results for
+# this graph at batch >= ~64 (libtpu version skew between the client's AOT
+# compiler and the terminal runtime — the runtime itself warns the
+# executable "may diverge"; B=16 verified correct, B=64 verified wrong,
+# CPU correct at every size). Raise once the fleet's libtpu is in sync —
+# bench.py probes 64 first and will pick it up automatically.
+DEFAULT_BUCKETS = (4, 16)
+
+
+def _bucket(n: int, buckets) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+# ---------------------------------------------------------------------------
+# Host-side packing: wire/host objects -> mont-domain limb arrays
+# ---------------------------------------------------------------------------
+
+def _g1_aff(p: PointG1) -> np.ndarray:
+    x, y = p.to_affine()
+    return np.stack([limb.int_to_mont_limbs(x.v), limb.int_to_mont_limbs(y.v)])
+
+
+def _g2_aff(q: PointG2) -> np.ndarray:
+    x, y = q.to_affine()
+    return np.stack([
+        np.stack([limb.int_to_mont_limbs(x.c0), limb.int_to_mont_limbs(x.c1)]),
+        np.stack([limb.int_to_mont_limbs(y.c0), limb.int_to_mont_limbs(y.c1)]),
+    ])
+
+
+class BatchedEngine:
+    """Stateful facade: owns the jitted graphs and the shape buckets."""
+
+    def __init__(self, buckets=DEFAULT_BUCKETS):
+        self.buckets = tuple(sorted(buckets))
+        self._verify = jax.jit(pairing.verify_prepared)
+        self._msm_g2 = jax.jit(
+            lambda pts, bits: curve.pt_to_affine(
+                curve.F2, curve.msm(curve.F2, pts, bits)))
+        self._msg_cache: dict[tuple[bytes, bytes], PointG2] = {}
+
+    # -- hashing (host, memoized: the aggregator re-verifies the same round
+    #    message for every partial) -----------------------------------------
+    def _hash_msg(self, msg: bytes, dst: bytes) -> PointG2:
+        key = (msg, dst)
+        got = self._msg_cache.get(key)
+        if got is None:
+            if len(self._msg_cache) > 4096:
+                self._msg_cache.clear()
+            got = hash_to_g2(msg, dst)
+            self._msg_cache[key] = got
+        return got
+
+    # ------------------------------------------------------------ verify
+    def verify_bls(self, triples) -> np.ndarray:
+        """Batch-verify BLS triples ``(pub: PointG1, sig: PointG2|None,
+        msg_point: PointG2)``; a None signature marks an entry already known
+        invalid (failed decode). Returns a bool array of len(triples).
+        Batches beyond the largest bucket run as multiple device calls."""
+        n = len(triples)
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        top = self.buckets[-1]
+        if n > top:
+            return np.concatenate([self.verify_bls(triples[i:i + top])
+                                   for i in range(0, n, top)])
+        b = _bucket(n, self.buckets)
+        pubs = np.zeros((b, 2, limb.NLIMBS), np.int32)
+        sigs = np.zeros((b, 2, 2, limb.NLIMBS), np.int32)
+        msgs = np.zeros((b, 2, 2, limb.NLIMBS), np.int32)
+        valid = np.zeros(b, dtype=bool)
+        # pad rows must be well-formed non-infinity points: use g1/g2 bases
+        pad_pub, pad_g2 = _g1_aff(PointG1.generator()), _g2_aff(PointG2.generator())
+        pubs[:], sigs[:], msgs[:] = pad_pub, pad_g2, pad_g2
+        for i, (pub, sig, msg_pt) in enumerate(triples):
+            if sig is None or sig.is_infinity() or pub.is_infinity() \
+                    or msg_pt.is_infinity():
+                continue
+            pubs[i], sigs[i], msgs[i] = _g1_aff(pub), _g2_aff(sig), _g2_aff(msg_pt)
+            valid[i] = True
+        ok = np.asarray(self._verify(jnp.asarray(pubs), jnp.asarray(sigs),
+                                     jnp.asarray(msgs)))
+        return (ok & valid)[:n]
+
+    def verify_beacons(self, pubkey: PointG1, beacons,
+                       dst: bytes = DEFAULT_DST_G2) -> np.ndarray:
+        """Dual-verify a span of beacons (V1 chain message + V2 when present)
+        in one flattened batch — the chain-catchup hot path
+        (client/verify.go:146-163 made parallel). Returns per-beacon bools."""
+        from ..chain import beacon as chain_beacon
+
+        triples = []
+        spans = []  # (start, count) per beacon
+        for bcn in beacons:
+            start = len(triples)
+            msg = chain_beacon.message(bcn.round, bcn.previous_sig)
+            triples.append((pubkey, _decode_sig(bcn.signature),
+                            self._hash_msg(msg, dst)))
+            if bcn.is_v2():
+                msg2 = chain_beacon.message_v2(bcn.round)
+                triples.append((pubkey, _decode_sig(bcn.signature_v2),
+                                self._hash_msg(msg2, dst)))
+            spans.append((start, len(triples) - start))
+        flat = self.verify_bls(triples)
+        return np.array([bool(flat[s:s + c].all()) for s, c in spans])
+
+    def verify_sigs(self, pubkey: PointG1, pairs,
+                    dst: bytes = DEFAULT_DST_G2) -> list[bool]:
+        """Batch of (msg, sig_bytes) full-signature checks against one
+        public key — the aggregator's V1+V2 re-verification
+        (chain/beacon/chain.go:141,159)."""
+        triples = [(pubkey, _decode_sig(sig), self._hash_msg(msg, dst))
+                   for msg, sig in pairs]
+        return [bool(v) for v in self.verify_bls(triples)]
+
+    def verify_partials(self, pub_poly: PubPoly, msg: bytes, partials,
+                        dst: bytes = DEFAULT_DST_G2) -> list[bool]:
+        """All partials of one round against their public key shares."""
+        msg_pt = self._hash_msg(msg, dst)
+        triples = []
+        for p in partials:
+            if len(p) != tbls.PARTIAL_SIG_SIZE:
+                triples.append((PointG1.generator(), None, msg_pt))
+                continue
+            idx = tbls.index_of(p)
+            triples.append((pub_poly.eval(idx).value,
+                            _decode_sig(p[tbls.INDEX_BYTES:]), msg_pt))
+        return [bool(v) for v in self.verify_bls(triples)]
+
+    # ------------------------------------------------------------ recover
+    def recover(self, pub_poly: PubPoly, msg: bytes, partials, t: int, n: int,
+                dst: bytes = DEFAULT_DST_G2) -> bytes:
+        """Lagrange-recover the full signature on device: one G2 MSM with
+        the Lagrange coefficients as scalars (Scheme.Recover,
+        chain/beacon/chain.go:136). Same selection semantics as the host
+        tbls.recover: first t distinct valid indices win."""
+        shares: list[PubShare] = []
+        seen: set[int] = set()
+        for p in partials:
+            if len(p) != tbls.PARTIAL_SIG_SIZE:
+                continue
+            idx = tbls.index_of(p)
+            if idx in seen or idx >= n:
+                continue
+            pt = _decode_sig(p[tbls.INDEX_BYTES:])
+            if pt is None:
+                continue
+            seen.add(idx)
+            shares.append(PubShare(idx, pt))
+            if len(shares) == t:
+                break
+        if len(shares) < t:
+            raise ValueError(f"not enough valid partials: {len(shares)} < {t}")
+        lambdas = lagrange_coefficients([s.index for s in shares])
+        b = _bucket(t, self.buckets)
+        pad = _g2_aff(PointG2.generator())
+        pts_np = np.broadcast_to(pad, (b, 2, 2, limb.NLIMBS)).copy()
+        inf = np.ones(b, dtype=bool)  # padding rows masked out as infinity
+        bits = np.zeros((b, 255), np.int32)
+        for i, s in enumerate(shares):
+            pts_np[i] = _g2_aff(s.value)
+            inf[i] = False
+            bits[i] = curve.scalar_to_bits(lambdas[s.index] % R, 255)
+        z_one = np.zeros((b, 2, limb.NLIMBS), np.int32)
+        z_one[:, 0] = np.asarray(limb.ONE_MONT)
+        pts = (jnp.asarray(pts_np[:, 0]), jnp.asarray(pts_np[:, 1]),
+               jnp.asarray(z_one), jnp.asarray(inf))
+        x_aff, y_aff, is_inf = self._msm_g2(pts, jnp.asarray(bits))
+        if bool(np.asarray(is_inf)):
+            raise ValueError("recovered signature is the point at infinity")
+        from ..crypto.fields import Fp2
+        x_aff, y_aff = np.asarray(x_aff), np.asarray(y_aff)
+        rec = PointG2(
+            Fp2(limb.fp_from_device(x_aff[0]), limb.fp_from_device(x_aff[1])),
+            Fp2(limb.fp_from_device(y_aff[0]), limb.fp_from_device(y_aff[1])),
+            Fp2.one(),
+        )
+        return rec.to_bytes()
+
+
+def _decode_sig(sig_bytes: bytes) -> PointG2 | None:
+    """Wire signature -> subgroup-checked point; None if malformed."""
+    try:
+        pt = PointG2.from_bytes(sig_bytes)
+    except ValueError:
+        return None
+    if pt.is_infinity():
+        return None
+    return pt

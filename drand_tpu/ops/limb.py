@@ -68,6 +68,12 @@ def limbs_to_int(a) -> int:
     return sum(int(v) << (BITS * i) for i, v in enumerate(a.tolist()))
 
 
+def int_to_mont_limbs(x: int) -> np.ndarray:
+    """Host int -> Montgomery-domain limb vector (numpy; the shared packing
+    used by the engine's host-side preparation)."""
+    return int_to_limbs(x * R_MONT % P)
+
+
 def fp_to_device(x: int, mont: bool = True):
     """Host int -> device limbs (Montgomery form by default)."""
     if mont:
@@ -329,7 +335,10 @@ def exact_normalize(t: jnp.ndarray) -> jnp.ndarray:
         s = x + carry
         return s >> BITS, s & MASK
 
-    carry0 = jnp.zeros(t.shape[:-1], dtype=DTYPE)
+    # derive the initial carry from t (not a fresh constant) so it inherits
+    # t's varying-manual-axes type under shard_map — a constant carry fails
+    # lax.scan's carry typecheck inside a mapped region
+    carry0 = t[..., 0] * 0
     # scan over the limb axis (move it to front)
     xs = jnp.moveaxis(t, -1, 0)
     carry, ys = jax.lax.scan(step, carry0, xs)
@@ -360,7 +369,7 @@ def pow_const(a: jnp.ndarray, e: int) -> jnp.ndarray:
     if e < 0:
         raise ValueError("negative exponent (use inverse)")
     if e == 0:
-        return jnp.broadcast_to(jnp.asarray(ONE_MONT), a.shape)
+        return jnp.asarray(ONE_MONT) + a * 0
     bits = np.array([(e >> i) & 1 for i in range(e.bit_length())], dtype=np.int32)
 
     def step(state, bit):
@@ -369,7 +378,9 @@ def pow_const(a: jnp.ndarray, e: int) -> jnp.ndarray:
         base = mont_sqr(base)
         return (result, base), None
 
-    init = (jnp.broadcast_to(jnp.asarray(ONE_MONT), a.shape), a)
+    # `one + a*0` (not broadcast_to of a constant): keeps the scan carry's
+    # varying-manual-axes type aligned with `a` under shard_map
+    init = (jnp.asarray(ONE_MONT) + a * 0, a)
     (result, _), _ = jax.lax.scan(step, init, jnp.asarray(bits))
     return result
 

@@ -10,15 +10,19 @@ Design:
 - Lines are denominator-eliminated (scaled by Fp2 factors, which the final
   exponentiation kills), so the Miller loop is inversion-free: T is tracked
   in Jacobian coordinates on the twist.
-- The Miller loop over |x| is ONE `lax.scan` (compile-time critical: a
-  single traced body); the rare addition steps run under `lax.cond`, so
-  only the ~6 set bits of |x| pay for the mixed addition.
+- The Miller loop over |x| runs as pure-doubling `lax.scan` segments with
+  the ~5 mixed-addition steps unrolled at the set bits of |x|. There is
+  deliberately NO `lax.cond`/`lax.switch` inside any `lax.scan`: that
+  construct miscompiles on the axon TPU backend for batches >= ~64 (plain
+  scans are fine at every size). Do not re-fuse the loop into a single
+  scan with conditional add steps without re-running the batch-64
+  regression (tests/test_batch_engine.py::test_batch64_regression).
 - Sparse line multiplication: the line has w-coefficients only at slots
   {0, 3, 5} (M-twist untwist (x,y) -> (xi^-1 x w^4, xi^-1 y w^3)), one
   stacked Fp2-multiply per application.
-- Final exponentiation = easy part + Hayashida chain (cube of the canonical
-  pairing; equality checks are cube-invariant), with the five pow-by-x
-  stages fused into a single scan over a (bit, boundary, segment) schedule.
+- Final exponentiation = easy part + Hayashida chain (cube of the
+  canonical pairing; equality checks are cube-invariant) as five separate
+  plain pow scans with explicit glue (same no-cond-in-scan rule).
   `canonical=True` corrects by 3^-1 mod r for GT interop (timelock IBE).
 
 Host golden reference: drand_tpu.crypto.pairing.
@@ -151,17 +155,34 @@ def _add_step(T, q_aff, p_aff):
     return (Xn, Yn, Zn), (c0, c3, c5)
 
 
-# Bit schedule of |x| (MSB implicit): one scan iteration per bit; a '1' bit
-# additionally performs the mixed-addition step (under lax.cond — the
-# predicate is a scalar per step, so only ~6 of 63 iterations pay for it).
+# Bit schedule of |x| (MSB implicit), segmented at the set bits: |x| has
+# hamming weight 6, so the loop is a handful of pure-doubling lax.scan
+# segments with the ~5 mixed additions unrolled at the segment boundaries.
+# NO lax.cond inside lax.scan: that construct miscompiles on the axon TPU
+# backend for batch >= ~64 (observed jax 0.9.0: correct at B=16, all-wrong
+# at B=64; plain scans are fine at every size — see tests/test_ops_golden
+# batch-64 regression).
 _X_ABS = abs(X_BLS)
 _BITS_MSB = bin(_X_ABS)[3:]  # after the implicit leading 1
-_MILLER_BITS = np.array([int(_ch) for _ch in _BITS_MSB], dtype=np.int32)
+# run-lengths of doubling steps between additions: for each '1' bit at
+# position i (0-based after MSB), an add follows (i+1 - prev) doublings
+_MILLER_SEGMENTS: list[int] = []  # doubling-run lengths
+_MILLER_ADDS: list[bool] = []     # whether an add follows the run
+_run = 0
+for _ch in _BITS_MSB:
+    _run += 1
+    if _ch == "1":
+        _MILLER_SEGMENTS.append(_run)
+        _MILLER_ADDS.append(True)
+        _run = 0
+if _run:
+    _MILLER_SEGMENTS.append(_run)
+    _MILLER_ADDS.append(False)
 
 
 def miller_loop(p_affs, q_affs):
-    """Batched shared-squaring Miller loop — a single lax.scan over the bits
-    of |x| (compile-time critical: one traced body, 63 iterations).
+    """Batched shared-squaring Miller loop — pure-doubling scans segmented
+    at the set bits of |x|, additions unrolled (cond-free; see above).
 
     p_affs: tuple (xp, yp) arrays shaped (..., npairs, 32), mont domain.
     q_affs: (..., npairs, 2, 2, 32) affine twist points, mont domain.
@@ -170,99 +191,83 @@ def miller_loop(p_affs, q_affs):
     """
     npairs = q_affs.shape[-4]
     xq, yq = q_affs[..., 0, :, :], q_affs[..., 1, :, :]
-    T = (xq, yq, tower.f2_one(xq.shape[:-2]))
-    batch_shape = q_affs.shape[:-4]
-    f = jnp.broadcast_to(f12_one(), batch_shape + (2, 3, 2, limb.NLIMBS))
+    T = (xq, yq, tower.f2_one(xq.shape[:-2]) + xq * 0)
+    # f's initial value is derived from the inputs (not a broadcast
+    # constant) so the scan carry keeps the inputs' varying-manual-axes
+    # type under shard_map
+    tag = q_affs[..., 0, 0, 0, 0][..., None, None, None, None] * 0
+    f = f12_one() + tag
 
-    def add_part(state):
-        f, T = state
-        T, (c0, c3, c5) = _add_step(T, q_affs, p_affs)
-        f = _sparse_mul_035(f, c0, c3, c5, npairs)
-        return (f, T)
-
-    def body(state, bit):
+    def dbl_body(state, _):
         f, T = state
         f = f12_sqr(f)
         T, (c0, c3, c5) = _dbl_step(T, p_affs)
         f = _sparse_mul_035(f, c0, c3, c5, npairs)
-        state = jax.lax.cond(bit.astype(bool), add_part, lambda s: s, (f, T))
-        return state, None
+        return (f, T), None
 
-    (f, T), _ = jax.lax.scan(body, (f, T), jnp.asarray(_MILLER_BITS))
+    for seg_len, has_add in zip(_MILLER_SEGMENTS, _MILLER_ADDS):
+        (f, T), _ = jax.lax.scan(dbl_body, (f, T), None, length=seg_len)
+        if has_add:
+            T, (c0, c3, c5) = _add_step(T, q_affs, p_affs)
+            f = _sparse_mul_035(f, c0, c3, c5, npairs)
     return f12_conj(f)  # x < 0
 
 
 # ---------------------------------------------------------------------------
 # Final exponentiation (mirrors crypto/pairing.py final_exponentiation).
 #
-# The Hayashida hard part is FIVE pow-by-(~x) chains; tracing five separate
-# scans quintuples compile time, so the whole chain runs as ONE lax.scan over
-# a (bit, boundary, segment) schedule. Each step is a MSB-first pow step
-# (acc <- acc^2; acc <- acc*base if bit); at the 5 segment boundaries a
-# lax.switch applies the inter-pow glue (frobenius multiplies, base/acc
-# reload). Registers: acc, base, keep (holds a2 then a3).
+# The Hayashida hard part runs as FIVE pow-by-(~x) cyclotomic chains with
+# explicit glue between them. Each pow is a plain lax.scan (MSB-first
+# square-and-multiply with the multiply under a masked select) — NO
+# lax.cond/lax.switch inside lax.scan, which miscompiles on the axon TPU
+# backend at batch >= ~64 (see miller_loop's note). The extra scans cost
+# compile time once; the persistent compilation cache absorbs it.
 #
-#   seg0: a1 = m^(x-1)            = pow(conj(m), |x-1|)          [x < 0]
-#   seg1: a2 = a1^(x-1)
-#   seg2: a3 = a2^x * frob1(a2)
-#   seg3: t  = a3^x
-#   seg4: a4 = t^x * frob2(a3) * conj(a3)
+#   a1 = m^(x-1)            = pow(conj(m), |x-1|)          [x < 0]
+#   a2 = a1^(x-1)
+#   a3 = a2^x * frob1(a2)
+#   t  = a3^x
+#   a4 = t^x * frob2(a3) * conj(a3)
 #   out: cubed = a4 * m^3  (host: a * m * cyclotomic_square(m))
 # ---------------------------------------------------------------------------
 
 _INV3_MOD_R = pow(3, -1, R)
 
-_SEG_LEN = 64  # covers |x-1| and |x| (both 64-bit)
+
+def _msb_bits(e: int) -> np.ndarray:
+    return np.array([int(c) for c in bin(e)[2:]], dtype=np.int32)
 
 
-def _msb_bits(e: int, width: int) -> np.ndarray:
-    return np.array([(e >> (width - 1 - i)) & 1 for i in range(width)],
-                    dtype=np.int32)
+_BITS_X_M1 = _msb_bits(abs(X_BLS - 1))
+_BITS_X = _msb_bits(abs(X_BLS))
 
 
-_HARD_EXPS = [abs(X_BLS - 1), abs(X_BLS - 1), abs(X_BLS), abs(X_BLS), abs(X_BLS)]
-_HARD_BITS = np.concatenate([_msb_bits(e, _SEG_LEN) for e in _HARD_EXPS])
-_HARD_BOUNDARY = np.zeros(5 * _SEG_LEN, dtype=np.int32)
-_HARD_BOUNDARY[_SEG_LEN - 1 :: _SEG_LEN] = 1
-_HARD_SEG = np.repeat(np.arange(5, dtype=np.int32), _SEG_LEN)
+def _cyc_pow_neg(m, bits: np.ndarray):
+    """m^(-|e|) for cyclotomic m, MSB-first plain scan (x < 0: the caller's
+    exponents are x or x-1, both negative, so the base is conjugated)."""
+    base = f12_conj(m)
+    one = f12_one() + m * 0
 
-
-def _hard_part(m):
-    """m^(hard exponent) for cyclotomic m — single-scan Hayashida chain."""
-    one = jnp.broadcast_to(f12_one(), m.shape)
-
-    def glue0(r, keep):  # also seg3
-        return one, f12_conj(r), keep
-    def glue1(r, keep):
-        return one, f12_conj(r), r
-    def glue2(r, keep):
-        rr = f12_mul(r, f12_frobenius(keep, 1))
-        return one, f12_conj(rr), rr
-    def glue4(r, keep):
-        out = f12_mul(f12_mul(r, f12_frobenius(keep, 2)), f12_conj(keep))
-        return out, f12_conj(r), keep
-
-    def body(state, x):
-        bit, boundary, seg = x
-        acc, base, keep = state
+    def body(acc, bit):
         acc = f12_cyclotomic_sqr(acc)
         acc = tower.f12_select(
             jnp.broadcast_to(bit.astype(bool), acc.shape[:-4]),
             f12_mul(acc, base), acc)
+        return acc, None
 
-        def at_boundary(s):
-            acc, base, keep = s
-            return jax.lax.switch(
-                seg, [glue0, glue1, glue2, glue0, glue4], acc, keep)
-
-        state = jax.lax.cond(boundary.astype(bool), at_boundary, lambda s: s,
-                             (acc, base, keep))
-        return state, None
-
-    xs = (jnp.asarray(_HARD_BITS), jnp.asarray(_HARD_BOUNDARY),
-          jnp.asarray(_HARD_SEG))
-    (acc, _, _), _ = jax.lax.scan(body, (one, f12_conj(m), m), xs)
+    acc, _ = jax.lax.scan(body, one, jnp.asarray(bits))
     return acc
+
+
+def _hard_part(m):
+    """m^(hard exponent) for cyclotomic m — Hayashida chain."""
+    a1 = _cyc_pow_neg(m, _BITS_X_M1)
+    a2 = _cyc_pow_neg(a1, _BITS_X_M1)
+    a3 = f12_mul(_cyc_pow_neg(a2, _BITS_X), f12_frobenius(a2, 1))
+    t = _cyc_pow_neg(a3, _BITS_X)
+    a4 = f12_mul(f12_mul(_cyc_pow_neg(t, _BITS_X), f12_frobenius(a3, 2)),
+                 f12_conj(a3))
+    return a4
 
 
 def final_exponentiation(f, canonical: bool = False):
@@ -298,10 +303,8 @@ def _neg_g1():
     global _NEG_G1_AFF
     if _NEG_G1_AFF is None:
         x, y = (-PointG1.generator()).to_affine()
-        _NEG_G1_AFF = np.stack([
-            limb.int_to_limbs(x.v * limb.R_MONT % P),
-            limb.int_to_limbs(y.v * limb.R_MONT % P),
-        ])
+        _NEG_G1_AFF = np.stack([limb.int_to_mont_limbs(x.v),
+                                limb.int_to_mont_limbs(y.v)])
     return jnp.asarray(_NEG_G1_AFF)
 
 

@@ -149,7 +149,9 @@ def f2_pow_const(a, e: int):
                            f2_mul(result, base), result)
         return (result, f2_sqr(base)), None
 
-    (result, _), _ = jax.lax.scan(step, (jnp.broadcast_to(f2_one(), a.shape), a),
+    # `one + a*0` keeps the carry's varying-manual-axes type aligned with
+    # `a` under shard_map (a broadcast constant fails the carry typecheck)
+    (result, _), _ = jax.lax.scan(step, (f2_one() + a * 0, a),
                                   jnp.asarray(bits))
     return result
 
@@ -351,5 +353,5 @@ def f12_cyc_pow_const(a, e: int):
         return (result, f12_cyclotomic_sqr(base)), None
 
     (result, _), _ = jax.lax.scan(
-        step, (jnp.broadcast_to(f12_one(), a.shape), a), jnp.asarray(bits))
+        step, (f12_one() + a * 0, a), jnp.asarray(bits))
     return result

@@ -3,14 +3,23 @@
 Sharding/multi-chip tests run on a virtual 8-device CPU mesh (the driver
 separately dry-run-compiles the multi-chip path; real TPU hardware has one
 chip under axon). Set up the XLA flags BEFORE jax is imported anywhere.
+
+NB: under the axon image a sitecustomize imports jax at interpreter boot,
+so the JAX_PLATFORMS assignment below only takes effect when the suite runs
+with a clean PYTHONPATH (PYTHONPATH= JAX_PLATFORMS=cpu python -m pytest …);
+under the ambient environment the suite runs against the tunneled TPU chip,
+which is also a valid (slower, hardware-exercising) configuration. Tests
+that REQUIRE more than one device must check jax.device_count() and skip.
 """
 
+import asyncio
+import inspect
 import os
 import sys
 
 # Force-assign (not setdefault): the ambient shell defaults to
-# JAX_PLATFORMS=axon (remote TPU tunnel); the test suite must run on the
-# virtual CPU mesh regardless.
+# JAX_PLATFORMS=axon (remote TPU tunnel); the test suite prefers the
+# virtual CPU mesh when jax has not been imported yet.
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -23,3 +32,22 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from drand_tpu.utils.jit_cache import enable_persistent_cache  # noqa: E402
 
 enable_persistent_cache()
+
+import pytest  # noqa: E402
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    """Run ``async def`` tests via asyncio.run (no pytest-asyncio in the
+    image); the inert @pytest.mark.asyncio markers stay readable."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {name: pyfuncitem.funcargs[name]
+                  for name in pyfuncitem._fixtureinfo.argnames}
+        asyncio.run(fn(**kwargs))
+        return True
+    return None
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "asyncio: async test (run by conftest)")
