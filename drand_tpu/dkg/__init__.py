@@ -1,0 +1,21 @@
+"""Pedersen distributed key generation and resharing.
+
+TPU-native replacement for the reference's kyber `dkg` package as driven by
+core/drand_control.go:123 (runDKG) and :196 (runResharing): deal/response/
+justification phases, QUAL selection, fast-sync, nonce binding, and the
+resharing variant (OldNodes/PublicCoeffs/OldThreshold).
+"""
+
+from .packets import (  # noqa: F401
+    Deal,
+    DealBundle,
+    Justification,
+    JustificationBundle,
+    Response,
+    ResponseBundle,
+    STATUS_APPROVAL,
+    STATUS_COMPLAINT,
+)
+from .protocol import DKGConfig, DKGError, DistKeyShare, DKGProtocol  # noqa: F401
+from .board import Board, BroadcastBoard, LocalBoard  # noqa: F401
+from .phaser import Phase, TimePhaser  # noqa: F401

@@ -1,0 +1,153 @@
+"""DKG boards: where bundles are pushed and received.
+
+``Board`` is the kyber ``dkg.Board`` analogue; ``BroadcastBoard`` is the
+reference's best-effort rebroadcast gossip (core/broadcast.go:38): every
+accepted bundle is verified (issuer signature + session nonce), deduped by
+hash, delivered locally, and re-sent to every peer — so a bundle reaches
+everyone even if its origin can only reach a subset of the group (the
+reason the reference gossips DKG packets at all).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..crypto import schnorr
+from ..key.keys import Node
+from ..utils.logging import KVLogger
+from .packets import DealBundle, JustificationBundle, ResponseBundle
+
+
+class Board:
+    """Local queues + an outbound hook. Protocol consumes the queues."""
+
+    def __init__(self):
+        self.deals: asyncio.Queue[DealBundle] = asyncio.Queue()
+        self.responses: asyncio.Queue[ResponseBundle] = asyncio.Queue()
+        self.justifications: asyncio.Queue[JustificationBundle] = asyncio.Queue()
+
+    async def push_deals(self, bundle: DealBundle) -> None:
+        raise NotImplementedError
+
+    async def push_responses(self, bundle: ResponseBundle) -> None:
+        raise NotImplementedError
+
+    async def push_justifications(self, bundle: JustificationBundle) -> None:
+        raise NotImplementedError
+
+
+class LocalBoard(Board):
+    """Single-process fan-out for tests: a shared registry of boards."""
+
+    def __init__(self, registry: list["LocalBoard"] | None = None):
+        super().__init__()
+        self._registry = registry if registry is not None else [self]
+
+    @staticmethod
+    def make_group(n: int) -> list["LocalBoard"]:
+        registry: list[LocalBoard] = []
+        for _ in range(n):
+            registry.append(LocalBoard(registry))
+        return registry
+
+    async def _fan(self, kind: str, bundle) -> None:
+        for b in self._registry:
+            getattr(b, kind).put_nowait(bundle)
+
+    async def push_deals(self, bundle: DealBundle) -> None:
+        await self._fan("deals", bundle)
+
+    async def push_responses(self, bundle: ResponseBundle) -> None:
+        await self._fan("responses", bundle)
+
+    async def push_justifications(self, bundle: JustificationBundle) -> None:
+        await self._fan("justifications", bundle)
+
+
+class BroadcastBoard(Board):
+    """Gossip board over the node->node transport (core/broadcast.go).
+
+    Outbound: sign is the caller's job (the protocol signs bundles); push
+    delivers locally then sends to every peer in parallel.
+    Inbound (`receive` — wired to the transport's broadcast_dkg service):
+    verify signature against the issuer's longterm key, drop duplicates and
+    wrong-session bundles, deliver locally, rebroadcast to all peers.
+    """
+
+    def __init__(self, client, own_addr: str, dealers: list[Node],
+                 receivers: list[Node], nonce: bytes, logger: KVLogger):
+        super().__init__()
+        self._client = client
+        self._addr = own_addr
+        self._dealers = dealers
+        self._receivers = receivers
+        self._nonce = nonce
+        self._l = logger
+        self._seen: set[bytes] = set()
+        self._peers = {n.address(): n for n in dealers + receivers
+                       if n.address() != own_addr}
+
+    # ---------------------------------------------------------------- out
+    async def push_deals(self, bundle: DealBundle) -> None:
+        await self._accept(bundle, rebroadcast=True)
+
+    async def push_responses(self, bundle: ResponseBundle) -> None:
+        await self._accept(bundle, rebroadcast=True)
+
+    async def push_justifications(self, bundle: JustificationBundle) -> None:
+        await self._accept(bundle, rebroadcast=True)
+
+    # ----------------------------------------------------------------- in
+    async def receive(self, from_addr: str, bundle) -> None:
+        """Transport ingress (ProtocolService.broadcast_dkg)."""
+        await self._accept(bundle, rebroadcast=True)
+
+    def _issuer(self, bundle) -> Node | None:
+        if isinstance(bundle, (DealBundle, JustificationBundle)):
+            nodes, idx = self._dealers, bundle.dealer_index
+        else:
+            nodes, idx = self._receivers, bundle.share_index
+        for n in nodes:
+            if n.index == idx:
+                return n
+        return None
+
+    def _verify(self, bundle) -> bool:
+        if bundle.session_id != self._nonce:
+            return False
+        issuer = self._issuer(bundle)
+        if issuer is None:
+            return False
+        return schnorr.verify(issuer.identity.key, bundle.hash(),
+                              bundle.signature)
+
+    async def _accept(self, bundle, rebroadcast: bool) -> None:
+        key = bundle.hash() + bundle.signature[:16]
+        if key in self._seen:
+            return
+        if not self._verify(bundle):
+            self._l.debug("dkg_board", "invalid_bundle",
+                          kind=type(bundle).__name__)
+            return
+        self._seen.add(key)
+        from .. import metrics
+
+        metrics.DKG_BUNDLES.labels(kind=type(bundle).__name__).inc()
+        if isinstance(bundle, DealBundle):
+            self.deals.put_nowait(bundle)
+        elif isinstance(bundle, ResponseBundle):
+            self.responses.put_nowait(bundle)
+        else:
+            self.justifications.put_nowait(bundle)
+        if rebroadcast:
+            for peer in self._peers.values():
+                asyncio.ensure_future(self._send(peer, bundle))
+
+    async def _send(self, peer: Node, bundle) -> None:
+        try:
+            await self._client.broadcast_dkg(peer.identity, bundle)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # best-effort gossip (broadcast.go:143)
+            self._l.debug("dkg_board", "send_failed", to=peer.address(),
+                          err=str(e))

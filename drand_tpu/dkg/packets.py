@@ -1,0 +1,127 @@
+"""DKG wire bundles: deals, responses, justifications.
+
+Mirrors the reference's protobuf DKG packet shapes
+(protobuf/crypto/dkg/dkg.proto:14-93, converted at core/convert.go:24) and
+kyber's bundle semantics: every bundle carries the issuer's index, a session
+nonce, and a signature over the bundle's canonical hash (verified on ingress
+— core/broadcast.go:53 `dkg.VerifyPacketSignature` analogue).
+
+Canonical encoding: length-prefixed concatenation; hashes are blake2b-256.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+from ..crypto.curves import PointG1
+
+STATUS_COMPLAINT = 0
+STATUS_APPROVAL = 1
+
+
+def _u16(v: int) -> bytes:
+    return v.to_bytes(2, "big")
+
+
+def _u32(v: int) -> bytes:
+    return v.to_bytes(4, "big")
+
+
+def _blob(b: bytes) -> bytes:
+    return _u32(len(b)) + b
+
+
+@dataclass(frozen=True)
+class Deal:
+    """Encrypted share evaluation for one receiver (dkg.proto Deal)."""
+
+    share_index: int     # receiver's index in the NEW group
+    encrypted_share: bytes  # ECIES under the receiver's longterm key
+
+    def encode(self) -> bytes:
+        return _u16(self.share_index) + _blob(self.encrypted_share)
+
+
+@dataclass(frozen=True)
+class DealBundle:
+    """All of one dealer's deals plus its polynomial commitments."""
+
+    dealer_index: int           # index in the DEALER set (old group if reshare)
+    commits: tuple[bytes, ...]  # compressed G1 commitments, degree t-1
+    deals: tuple[Deal, ...]
+    session_id: bytes           # the DKG nonce
+    signature: bytes = b""      # schnorr by the dealer's longterm key
+
+    def hash(self) -> bytes:
+        h = hashlib.blake2b(digest_size=32)
+        h.update(b"dkg-deal")
+        h.update(_u16(self.dealer_index))
+        for c in self.commits:
+            h.update(c)
+        for d in self.deals:
+            h.update(d.encode())
+        h.update(_blob(self.session_id))
+        return h.digest()
+
+    def commit_points(self) -> list[PointG1]:
+        return [PointG1.from_bytes(c) for c in self.commits]
+
+
+@dataclass(frozen=True)
+class Response:
+    """Per-dealer verdict from one share receiver."""
+
+    dealer_index: int
+    status: int  # STATUS_APPROVAL / STATUS_COMPLAINT
+
+    def encode(self) -> bytes:
+        return _u16(self.dealer_index) + bytes([self.status])
+
+
+@dataclass(frozen=True)
+class ResponseBundle:
+    share_index: int  # responder's index in the NEW group
+    responses: tuple[Response, ...]
+    session_id: bytes
+    signature: bytes = b""
+
+    def hash(self) -> bytes:
+        h = hashlib.blake2b(digest_size=32)
+        h.update(b"dkg-response")
+        h.update(_u16(self.share_index))
+        for r in self.responses:
+            h.update(r.encode())
+        h.update(_blob(self.session_id))
+        return h.digest()
+
+
+@dataclass(frozen=True)
+class Justification:
+    """Plaintext share revealed in answer to a complaint."""
+
+    share_index: int
+    share: int  # Fr scalar, public once revealed
+
+    def encode(self) -> bytes:
+        return _u16(self.share_index) + self.share.to_bytes(32, "big")
+
+
+@dataclass(frozen=True)
+class JustificationBundle:
+    dealer_index: int
+    justifications: tuple[Justification, ...]
+    session_id: bytes
+    signature: bytes = b""
+
+    def hash(self) -> bytes:
+        h = hashlib.blake2b(digest_size=32)
+        h.update(b"dkg-justification")
+        h.update(_u16(self.dealer_index))
+        for j in self.justifications:
+            h.update(j.encode())
+        h.update(_blob(self.session_id))
+        return h.digest()
+
+
+DKGPacket = DealBundle | ResponseBundle | JustificationBundle

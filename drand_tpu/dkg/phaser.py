@@ -1,0 +1,32 @@
+"""Clock-driven phase transitions for the DKG.
+
+kyber's TimePhaser analogue as configured by the reference
+(core/drand_control.go:656-665): each phase lasts `phase_timeout`; the
+protocol may move earlier under fast-sync when all expected bundles have
+arrived (the phaser just bounds the wait).
+"""
+
+from __future__ import annotations
+
+import enum
+
+from ..utils.clock import Clock
+
+
+class Phase(enum.Enum):
+    INIT = 0
+    DEAL = 1
+    RESPONSE = 2
+    JUSTIFICATION = 3
+    FINISH = 4
+
+
+class TimePhaser:
+    """Sleeps `timeout` per phase on the injectable clock."""
+
+    def __init__(self, clock: Clock, timeout: float):
+        self._clock = clock
+        self.timeout = timeout
+
+    async def next_phase(self) -> None:
+        await self._clock.sleep(self.timeout)

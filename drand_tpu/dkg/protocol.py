@@ -1,0 +1,365 @@
+"""The Pedersen DKG / resharing state machine.
+
+Replaces kyber's `dkg.Protocol` as the reference drives it
+(core/drand_control.go:123 runDKG, :196 runResharing; config fields
+Suite/Longterm/NewNodes/OldNodes/PublicCoeffs/Threshold/OldThreshold/
+FastSync/Nonce/Auth — :126-141, :205-246):
+
+Phases (phaser-bounded, fast-sync short-circuits when all expected bundles
+arrived):
+  DEAL          every dealer commits to a secret polynomial and sends each
+                receiver an ECIES-encrypted share evaluation.
+  RESPONSE      every receiver verifies its deals and broadcasts a verdict
+                per dealer (approval / complaint).
+  JUSTIFICATION complained-against dealers reveal the disputed share in
+                plaintext; everyone re-verifies against the commitments.
+  FINISH        QUAL = dealers with a valid deal and no unresolved
+                complaint. Fresh DKG: share_j = Σ_{i∈QUAL} f_i(j), commits
+                summed pointwise. Resharing: dealers share their OLD share
+                (f_i(0) = s_i, bound by PublicCoeffs), and the new share is
+                the Lagrange combination Σ λ_i f_i(j) over an old-threshold
+                QUAL subset — the group key is preserved.
+
+Fresh DKG: dealers == receivers == new_nodes. Resharing: dealers are the
+old group, receivers the new group; a node can be either or both. Nodes
+leaving the group deal but receive no share (pri_share=None).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import secrets
+from dataclasses import dataclass, field
+
+from ..crypto import ecies, schnorr
+from ..crypto.curves import PointG1
+from ..crypto.fields import R
+from ..crypto.poly import PriPoly, PriShare, PubPoly, lagrange_coefficients
+from ..key.keys import Node, Pair
+from ..utils.clock import Clock, SystemClock
+from ..utils.logging import KVLogger, default_logger
+from .board import Board
+from .packets import (
+    Deal,
+    DealBundle,
+    Justification,
+    JustificationBundle,
+    Response,
+    ResponseBundle,
+    STATUS_APPROVAL,
+    STATUS_COMPLAINT,
+)
+from .phaser import Phase, TimePhaser
+
+
+class DKGError(Exception):
+    pass
+
+
+@dataclass
+class DKGConfig:
+    longterm: Pair
+    nonce: bytes
+    new_nodes: list[Node]
+    threshold: int
+    # resharing inputs (all-or-nothing):
+    old_nodes: list[Node] | None = None
+    public_coeffs: list[PointG1] | None = None
+    old_threshold: int = 0
+    share: PriShare | None = None  # our old share (dealers in a reshare)
+    # protocol knobs
+    fast_sync: bool = True
+    phase_timeout: float = 10.0
+    clock: Clock = field(default_factory=SystemClock)
+    logger: KVLogger | None = None
+    seed: bytes | None = None  # deterministic dealer polynomial (tests only)
+
+    @property
+    def resharing(self) -> bool:
+        return self.old_nodes is not None
+
+    def dealers(self) -> list[Node]:
+        return self.old_nodes if self.resharing else self.new_nodes
+
+
+@dataclass
+class DistKeyShare:
+    """kyber dkg.DistKeyShare analogue (core/drand.go:166 WaitDKG output)."""
+
+    commits: list[PointG1]
+    pri_share: PriShare | None  # None for a dealer leaving the group
+    qual: list[int]             # dealer indices in QUAL
+
+    def public_key(self) -> PointG1:
+        return self.commits[0]
+
+
+class DKGProtocol:
+    def __init__(self, conf: DKGConfig, board: Board):
+        self.c = conf
+        self.board = board
+        self._l = (conf.logger or default_logger("dkg")).named("proto")
+        dealers = conf.dealers()
+        self._dealer_index = _index_of(dealers, conf.longterm)
+        self._share_index = _index_of(conf.new_nodes, conf.longterm)
+        if self._dealer_index is None and self._share_index is None:
+            raise DKGError("longterm key neither deals nor receives")
+        if conf.resharing:
+            if not conf.public_coeffs or not conf.old_threshold:
+                raise DKGError("resharing requires public_coeffs and old_threshold")
+            if self._dealer_index is not None and conf.share is None:
+                raise DKGError("resharing dealer needs its old share")
+            self._old_pub = PubPoly(list(conf.public_coeffs))
+        else:
+            self._old_pub = None
+        self._phaser = TimePhaser(conf.clock, conf.phase_timeout)
+        # receiver state
+        self._valid_shares: dict[int, int] = {}      # dealer_index -> f_i(me)
+        self._valid_commits: dict[int, PubPoly] = {}  # dealer_index -> G_i
+        self._complaints_open: dict[int, set[int]] = {}  # dealer -> share idxs
+        self._approvals: dict[int, set[int]] = {}    # dealer -> approving idxs
+
+    # ------------------------------------------------------------------ run
+    async def run(self) -> DistKeyShare:
+        """Execute all phases; returns the distributed key share."""
+        dealers = self.c.dealers()
+        n_recv = len(self.c.new_nodes)
+
+        my_poly = None
+        if self._dealer_index is not None:
+            my_poly = self._make_poly()
+            await self.board.push_deals(self._make_deal_bundle(my_poly))
+
+        deals = await self._collect(
+            self.board.deals, expect=len(dealers),
+            issuer=lambda b: b.dealer_index)
+        for b in deals:
+            self._process_deal(b)
+
+        if self._share_index is not None:
+            await self.board.push_responses(self._make_response_bundle(dealers))
+        responses = await self._collect(
+            self.board.responses, expect=n_recv,
+            issuer=lambda b: b.share_index)
+        for b in responses:
+            self._process_response(b, dealers)
+
+        any_complaints = any(self._complaints_open.values())
+        if any_complaints:
+            if self._dealer_index is not None and \
+                    self._complaints_open.get(self._dealer_index):
+                await self.board.push_justifications(
+                    self._make_justification_bundle(my_poly))
+            complained = [d for d, s in self._complaints_open.items() if s]
+            justs = await self._collect(
+                self.board.justifications, expect=len(complained),
+                issuer=lambda b: b.dealer_index)
+            for b in justs:
+                self._process_justification(b)
+
+        return self._finish(dealers)
+
+    # ------------------------------------------------------------- dealing
+    def _make_poly(self) -> PriPoly:
+        if self.c.resharing:
+            # constant term MUST be our old share (bound by public_coeffs)
+            coeffs = [self.c.share.value]
+            for k in range(1, self.c.threshold):
+                coeffs.append(_rand_scalar(self.c.seed, self._dealer_index, k))
+            return PriPoly(coeffs)
+        coeffs = [_rand_scalar(self.c.seed, self._dealer_index, k)
+                  for k in range(self.c.threshold)]
+        return PriPoly(coeffs)
+
+    def _make_deal_bundle(self, poly: PriPoly) -> DealBundle:
+        commits = tuple(c.to_bytes() for c in poly.commit().commits)
+        deals = []
+        for node in self.c.new_nodes:
+            s = poly.eval(node.index)
+            enc = ecies.encrypt(node.identity.key, s.value.to_bytes(32, "big"))
+            deals.append(Deal(share_index=node.index, encrypted_share=enc))
+        bundle = DealBundle(
+            dealer_index=self._dealer_index, commits=commits,
+            deals=tuple(deals), session_id=self.c.nonce)
+        return _signed(bundle, self.c.longterm)
+
+    def _process_deal(self, b: DealBundle) -> None:
+        if b.dealer_index in self._valid_commits:
+            return  # first valid bundle per dealer wins
+        if len(b.commits) != self.c.threshold:
+            return
+        try:
+            pub = PubPoly(b.commit_points())
+        except ValueError:
+            return
+        if self._old_pub is not None:
+            # dealer's constant term must be its OLD public share —
+            # the key-preservation binding of a reshare
+            if pub.commit() != self._old_pub.eval(b.dealer_index).value:
+                self._l.warn("dkg", "reshare_commit_mismatch",
+                             dealer=b.dealer_index)
+                return
+        self._valid_commits[b.dealer_index] = pub
+        if self._share_index is None:
+            return
+        for d in b.deals:
+            if d.share_index != self._share_index:
+                continue
+            try:
+                plain = ecies.decrypt(self.c.longterm.key, d.encrypted_share)
+                val = int.from_bytes(plain, "big") % R
+            except Exception:  # noqa: BLE001 — malformed ciphertext
+                break
+            if PointG1.generator().mul(val) == \
+                    pub.eval(self._share_index).value:
+                self._valid_shares[b.dealer_index] = val
+            break
+
+    # ----------------------------------------------------------- responses
+    def _make_response_bundle(self, dealers: list[Node]) -> ResponseBundle:
+        responses = []
+        for node in dealers:
+            ok = node.index in self._valid_shares
+            responses.append(Response(
+                dealer_index=node.index,
+                status=STATUS_APPROVAL if ok else STATUS_COMPLAINT))
+        bundle = ResponseBundle(
+            share_index=self._share_index, responses=tuple(responses),
+            session_id=self.c.nonce)
+        return _signed(bundle, self.c.longterm)
+
+    def _process_response(self, b: ResponseBundle, dealers: list[Node]) -> None:
+        dealer_idxs = {n.index for n in dealers}
+        for r in b.responses:
+            if r.dealer_index not in dealer_idxs:
+                continue
+            if r.status == STATUS_COMPLAINT:
+                self._complaints_open.setdefault(r.dealer_index, set()).add(
+                    b.share_index)
+            else:
+                self._approvals.setdefault(r.dealer_index, set()).add(
+                    b.share_index)
+
+    # ------------------------------------------------------ justifications
+    def _make_justification_bundle(self, poly: PriPoly) -> JustificationBundle:
+        justs = []
+        for idx in sorted(self._complaints_open.get(self._dealer_index, ())):
+            justs.append(Justification(share_index=idx,
+                                       share=poly.eval(idx).value))
+        bundle = JustificationBundle(
+            dealer_index=self._dealer_index, justifications=tuple(justs),
+            session_id=self.c.nonce)
+        return _signed(bundle, self.c.longterm)
+
+    def _process_justification(self, b: JustificationBundle) -> None:
+        pub = self._valid_commits.get(b.dealer_index)
+        opened = self._complaints_open.get(b.dealer_index, set())
+        if pub is None or not opened:
+            return
+        for j in b.justifications:
+            if j.share_index not in opened:
+                continue
+            if PointG1.generator().mul(j.share % R) == \
+                    pub.eval(j.share_index).value:
+                opened.discard(j.share_index)
+                if j.share_index == self._share_index:
+                    # the revealed (now public) share is still OUR share
+                    self._valid_shares[b.dealer_index] = j.share % R
+
+    # --------------------------------------------------------------- finish
+    def _finish(self, dealers: list[Node]) -> DistKeyShare:
+        qual = [n.index for n in dealers
+                if n.index in self._valid_commits
+                and not self._complaints_open.get(n.index)]
+        need = self.c.old_threshold if self.c.resharing else self.c.threshold
+        if len(qual) < need:
+            raise DKGError(f"QUAL too small: {len(qual)} < {need} "
+                           f"(qual={qual})")
+        self._l.info("dkg", "qual", members=qual)
+
+        if not self.c.resharing:
+            commits = None
+            for i in qual:
+                pub = self._valid_commits[i]
+                commits = pub if commits is None else commits.add(pub)
+            pri = None
+            if self._share_index is not None:
+                missing = [i for i in qual if i not in self._valid_shares]
+                if missing:
+                    raise DKGError(f"missing shares from QUAL dealers {missing}")
+                val = sum(self._valid_shares[i] for i in qual) % R
+                pri = PriShare(self._share_index, val)
+            return DistKeyShare(commits=list(commits.commits), pri_share=pri,
+                                qual=qual)
+
+        # resharing: Lagrange-combine an old-threshold subset of QUAL.
+        # The subset MUST be canonical across nodes (first old_threshold of
+        # QUAL, which every node derives from the broadcast responses) —
+        # a locally-chosen subset would yield divergent group commitments.
+        subset = qual[: self.c.old_threshold]
+        if self._share_index is not None:
+            missing = [i for i in subset if i not in self._valid_shares]
+            if missing:
+                raise DKGError(
+                    f"reshare: missing shares from canonical QUAL subset "
+                    f"{missing}")
+        lambdas = lagrange_coefficients(subset)
+        commits = []
+        for k in range(self.c.threshold):
+            acc = PointG1.infinity()
+            for i in subset:
+                acc = acc + self._valid_commits[i].commits[k].mul(lambdas[i])
+            commits.append(acc)
+        pri = None
+        if self._share_index is not None:
+            val = sum(self._valid_shares[i] * lambdas[i] for i in subset) % R
+            pri = PriShare(self._share_index, val)
+        return DistKeyShare(commits=commits, pri_share=pri, qual=qual)
+
+    # ------------------------------------------------------------- plumbing
+    async def _collect(self, queue: asyncio.Queue, expect: int, issuer):
+        """Drain a board queue until the phase times out — or, under
+        fast-sync, as soon as `expect` distinct issuers have arrived."""
+        items: list = []
+        seen: set[int] = set()
+        deadline = asyncio.ensure_future(self._phaser.next_phase())
+        try:
+            while True:
+                if self.c.fast_sync and len(seen) >= expect:
+                    return items
+                get = asyncio.ensure_future(queue.get())
+                done, _ = await asyncio.wait(
+                    {get, deadline}, return_when=asyncio.FIRST_COMPLETED)
+                if get in done:
+                    b = get.result()
+                    if issuer(b) not in seen:
+                        seen.add(issuer(b))
+                        items.append(b)
+                else:
+                    get.cancel()
+                if deadline in done:
+                    return items
+        finally:
+            if not deadline.done():
+                deadline.cancel()
+
+
+def _index_of(nodes: list[Node], pair: Pair) -> int | None:
+    for n in nodes:
+        if n.identity.key == pair.public.key:
+            return n.index
+    return None
+
+
+def _signed(bundle, pair: Pair):
+    sig = schnorr.sign(pair.key, bundle.hash())
+    return type(bundle)(**{**bundle.__dict__, "signature": sig})
+
+
+def _rand_scalar(seed: bytes | None, dealer: int, k: int) -> int:
+    if seed is None:
+        return secrets.randbelow(R - 1) + 1
+    from ..crypto.fields import fr_from_seed
+
+    return fr_from_seed(b"dkg-coeff",
+                        seed + bytes([dealer & 0xFF, k & 0xFF]))
