@@ -137,6 +137,18 @@ class Handler(ProtocolService):
                           current_round=current_round)
             raise TransportError(
                 f"invalid round: {p.round} instead of {current_round}")
+        # stale partials are rejected BEFORE paying for pairings: anything
+        # outside the aggregator's window (chain_store.py) would be dropped
+        # there anyway, after full verification. The reference verifies
+        # first (node.go:96-130) — a free DoS amplification this avoids.
+        last_round = self.chain.last().round
+        from .chain_store import PARTIAL_CACHE_STORE_LIMIT
+
+        if not (last_round < p.round <= last_round + PARTIAL_CACHE_STORE_LIMIT + 1):
+            self._l.debug("process_partial", from_addr, stale_round=p.round,
+                          last=last_round)
+            raise TransportError(
+                f"stale round: {p.round} (chain at {last_round})")
         msg = chain_beacon.message(p.round, p.previous_sig)
         pub = self.crypto.get_pub()
         if not tbls.verify_partial(pub, msg, p.partial_sig):

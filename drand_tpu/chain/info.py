@@ -72,5 +72,8 @@ class Info:
             period=group.period,
             genesis_time=group.genesis_time,
             genesis_seed=group.get_genesis_seed(),
-            group_hash=group.hash(),
+            # reference semantics (chain/info.go:29): GroupHash is the
+            # GENESIS seed, not the current group hash — the chain hash
+            # must stay invariant across reshares
+            group_hash=group.get_genesis_seed(),
         )

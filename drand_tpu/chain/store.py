@@ -59,6 +59,38 @@ def genesis_beacon(info: Info) -> Beacon:
     return Beacon(round=0, previous_sig=b"", signature=info.genesis_seed)
 
 
+class WrappedStore(Store):
+    """Base for store decorators: delegates everything to ``_inner``;
+    subclasses override what they decorate."""
+
+    def __init__(self, inner: Store):
+        self._inner = inner
+
+    def __len__(self):
+        return len(self._inner)
+
+    def put(self, b: Beacon) -> None:
+        self._inner.put(b)
+
+    def last(self):
+        return self._inner.last()
+
+    def get(self, r):
+        return self._inner.get(r)
+
+    def cursor(self):
+        return self._inner.cursor()
+
+    def cursor_from(self, r):
+        return self._inner.cursor_from(r)
+
+    def del_round(self, r):
+        self._inner.del_round(r)
+
+    def close(self):
+        self._inner.close()
+
+
 class MemStore(Store):
     """Dict-backed store for tests and relays."""
 
@@ -182,12 +214,12 @@ class SQLiteStore(Store):
             self._conn.close()
 
 
-class AppendStore(Store):
+class AppendStore(WrappedStore):
     """Monotonicity guard: only round+1 with matching previous signature
     (chain/beacon/store.go:26-53)."""
 
     def __init__(self, inner: Store):
-        self._inner = inner
+        super().__init__(inner)
         self._lock = threading.Lock()
         try:
             self._last: Beacon | None = inner.last()
@@ -206,22 +238,6 @@ class AppendStore(Store):
             self._inner.put(b)
             self._last = b
 
-    # delegate reads
-    def __len__(self):
-        return len(self._inner)
-
-    def last(self):
-        return self._inner.last()
-
-    def get(self, r):
-        return self._inner.get(r)
-
-    def cursor(self):
-        return self._inner.cursor()
-
-    def cursor_from(self, r):
-        return self._inner.cursor_from(r)
-
     def del_round(self, r):
         with self._lock:
             self._inner.del_round(r)
@@ -230,17 +246,38 @@ class AppendStore(Store):
             except StoreError:
                 self._last = None
 
-    def close(self):
-        self._inner.close()
+
+class DiscrepancyStore(WrappedStore):
+    """Observability decorator (chain/beacon/store.go:57-82): on every
+    stored beacon, record how late it landed vs its scheduled round time
+    and the new chain tip, into the prometheus gauges."""
+
+    def __init__(self, inner: Store, group, clock):
+        super().__init__(inner)
+        self._group = group
+        self._clock = clock
+
+    def put(self, b: Beacon) -> None:
+        self._inner.put(b)
+        if b.round == 0:
+            return
+        from .. import metrics
+        from . import time_math
+
+        expected = time_math.time_of_round(self._group.period,
+                                           self._group.genesis_time, b.round)
+        metrics.BEACON_DISCREPANCY_LATENCY.set(
+            (self._clock.now() - expected) * 1000.0)
+        metrics.LAST_BEACON_ROUND.set(b.round)
 
 
-class CallbackStore(Store):
+class CallbackStore(WrappedStore):
     """Fans every stored beacon out to registered callbacks
     (chain/beacon/store.go:85; worker pool replaced by asyncio tasks).
     Callbacks may be sync or async; they never run for the genesis round."""
 
     def __init__(self, inner: Store):
-        self._inner = inner
+        super().__init__(inner)
         self._callbacks: dict[str, Callable] = {}
         self._lock = threading.Lock()
 
@@ -262,24 +299,3 @@ class CallbackStore(Store):
             res = cb(b)
             if asyncio.iscoroutine(res):
                 asyncio.ensure_future(res)
-
-    def __len__(self):
-        return len(self._inner)
-
-    def last(self):
-        return self._inner.last()
-
-    def get(self, r):
-        return self._inner.get(r)
-
-    def cursor(self):
-        return self._inner.cursor()
-
-    def cursor_from(self, r):
-        return self._inner.cursor_from(r)
-
-    def del_round(self, r):
-        self._inner.del_round(r)
-
-    def close(self):
-        self._inner.close()

@@ -1,0 +1,3 @@
+"""drand-tpu operator CLI (see __main__.py; reference cmd/drand-cli/)."""
+
+from .__main__ import main  # noqa: F401

@@ -1,0 +1,329 @@
+"""drand-tpu CLI.
+
+Reference: cmd/drand-cli/cli.go:251-430 — generate-keypair, start, stop,
+share (DKG/reshare), follow, get, show, util. A running daemon is driven
+through its localhost control port (cmd/drand-cli/control.go), exactly like
+`drand`.
+
+    python -m drand_tpu.cli generate-keypair --folder F addr:port
+    python -m drand_tpu.cli start --folder F [--control PORT] [--public-listen addr:port]
+    python -m drand_tpu.cli share --control PORT --leader --nodes N --threshold T --period S --secret-file F
+    python -m drand_tpu.cli share --control PORT --connect LEADER --secret-file F [--reshare [--leaving]]
+    python -m drand_tpu.cli follow --control PORT --sync-nodes a:p,b:p [--up-to R]
+    python -m drand_tpu.cli get public --url http://host:port [--round R]
+    python -m drand_tpu.cli get chain-info --url http://host:port
+    python -m drand_tpu.cli show {share|group|chain-info|public|status} --control PORT
+    python -m drand_tpu.cli util {check|ping} ...
+    python -m drand_tpu.cli stop --control PORT
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+
+def _folder(args) -> str:
+    return args.folder or os.path.join(os.path.expanduser("~"), ".drand-tpu")
+
+
+def _read_secret(args) -> bytes:
+    if args.secret_file:
+        with open(args.secret_file, "rb") as f:
+            secret = f.read().strip()
+    else:
+        secret = os.environ.get("DRAND_SHARE_SECRET", "").encode()
+    if len(secret) < 16:
+        raise SystemExit("setup secret must be at least 16 bytes "
+                         "(--secret-file or DRAND_SHARE_SECRET)")
+    return secret
+
+
+# ---------------------------------------------------------------- commands
+
+def cmd_generate_keypair(args) -> None:
+    from ..key.keys import new_key_pair
+    from ..key.store import FileStore
+
+    store = FileStore(_folder(args))
+    if store.has_key_pair() and not args.force:
+        raise SystemExit(f"keypair already exists in {store.key_folder} "
+                         f"(--force to overwrite)")
+    pair = new_key_pair(args.address, tls=not args.tls_disable)
+    store.save_key_pair(pair)
+    print(json.dumps({
+        "address": args.address,
+        "public_key": pair.public.key.to_bytes().hex(),
+        "folder": store.key_folder,
+    }, indent=2))
+
+
+def cmd_start(args) -> None:
+    asyncio.run(_run_daemon(args))
+
+
+async def _run_daemon(args) -> None:
+    from ..core.config import Config
+    from ..core.daemon import Drand
+    from ..key.store import FileStore
+    from ..net.control import ControlServer
+    from ..net.grpc_transport import GrpcClient, GrpcGateway
+    from ..utils.logging import default_logger
+
+    folder = _folder(args)
+    ks = FileStore(folder)
+    if not ks.has_key_pair():
+        raise SystemExit(f"no keypair in {folder}; run generate-keypair first")
+    logger = default_logger("drand", level=args.verbose and "debug" or "info")
+    conf = Config(folder=folder, control_port=args.control,
+                  db_path=os.path.join(folder, "db", "chain.db"),
+                  dkg_timeout=args.dkg_timeout)
+    priv_addr = None
+    client = None
+    d = Drand.load(ks, conf, None, logger)
+    priv_addr = args.private_listen or d.priv.public.addr
+    client = GrpcClient(own_addr=d.priv.public.addr)
+    d.client = client
+    gateway = GrpcGateway(d, priv_addr, logger.named("gw"))
+    await gateway.start()
+    control = ControlServer(d, args.control, logger.named("ctl"))
+    await control.start()
+    print(f"drand-tpu daemon up: rpc={priv_addr} control={control.port}",
+          flush=True)
+    if d.group is not None and d.share is not None:
+        d.start_beacon(catchup=True)
+        print(f"beacon resumed for group {d.group.hash().hex()[:16]}",
+              flush=True)
+    http_task = None
+    if args.public_listen:
+        http_task = asyncio.ensure_future(
+            _serve_public(d, args.public_listen, logger))
+    await control.wait_shutdown()
+    if http_task:
+        http_task.cancel()
+    await gateway.stop()
+    await control.stop()
+
+
+async def _serve_public(d, listen: str, logger) -> None:
+    """Start the REST API once the beacon exists (daemon may still be
+    pre-DKG at boot)."""
+    from ..client.direct import DirectClient
+    from ..http_server.server import PublicServer
+
+    while d.beacon is None:
+        await asyncio.sleep(0.5)
+    host, port = listen.rsplit(":", 1)
+    server = PublicServer(DirectClient(d.beacon), logger=logger.named("http"))
+    await server.start(host or "0.0.0.0", int(port))
+    logger.info("http", "serving", listen=listen)
+    await asyncio.Event().wait()
+
+
+def cmd_share(args) -> None:
+    async def run():
+        from ..net.control import ControlClient
+
+        ctl = ControlClient(args.control)
+        secret = _read_secret(args)
+        try:
+            if args.reshare:
+                if args.leader:
+                    out = await ctl.init_reshare_leader(
+                        args.nodes, args.threshold, secret,
+                        timeout=args.timeout)
+                else:
+                    old_group = None
+                    if args.from_group:
+                        old_group = json.load(open(args.from_group))
+                    out = await ctl.init_reshare_follower(
+                        args.connect, secret, old_group=old_group,
+                        leaving=args.leaving, timeout=args.timeout)
+            elif args.leader:
+                out = await ctl.init_dkg_leader(
+                    args.nodes, args.threshold, args.period, secret,
+                    timeout=args.timeout)
+            else:
+                out = await ctl.init_dkg_follower(args.connect, secret,
+                                                  timeout=args.timeout)
+            print(json.dumps(out, indent=2))
+        finally:
+            await ctl.close()
+
+    asyncio.run(run())
+
+
+def cmd_follow(args) -> None:
+    async def run():
+        from ..net.control import ControlClient
+
+        ctl = ControlClient(args.control)
+        try:
+            out = await ctl.follow(args.sync_nodes.split(","), args.up_to)
+            print(json.dumps(out, indent=2))
+        finally:
+            await ctl.close()
+
+    asyncio.run(run())
+
+
+def cmd_stop(args) -> None:
+    async def run():
+        from ..net.control import ControlClient
+
+        ctl = ControlClient(args.control)
+        try:
+            print(json.dumps(await ctl.shutdown()))
+        finally:
+            await ctl.close()
+
+    asyncio.run(run())
+
+
+def cmd_show(args) -> None:
+    async def run():
+        from ..net.control import ControlClient
+
+        ctl = ControlClient(args.control)
+        try:
+            if args.what == "chain-info":
+                out = await ctl.chain_info()
+            elif args.what == "group":
+                out = await ctl.group_file()
+            elif args.what == "public":
+                out = {"public_key": await ctl.public_key()}
+            elif args.what == "status":
+                out = await ctl.status()
+            else:  # share: public part only (private scalar stays on disk)
+                g = await ctl.group_file()
+                out = {"commits": g.get("public_key", [])}
+            print(json.dumps(out, indent=2))
+        finally:
+            await ctl.close()
+
+    asyncio.run(run())
+
+
+def cmd_get(args) -> None:
+    async def run():
+        from ..client.http import HTTPClient
+
+        src = HTTPClient(args.url)
+        try:
+            if args.what == "chain-info":
+                info = await src.info()
+                print(info.to_json())
+            else:
+                from ..client import new_client
+
+                info = await src.info()
+                client = new_client([src], chain_info=info)
+                r = await client.get(args.round)
+                print(json.dumps({
+                    "round": r.round,
+                    "randomness": r.randomness.hex(),
+                    "signature": r.signature.hex(),
+                }, indent=2))
+        finally:
+            await src.close()
+
+    asyncio.run(run())
+
+
+def cmd_util(args) -> None:
+    async def run():
+        if args.what == "ping":
+            from ..net.control import ControlClient
+
+            ctl = ControlClient(args.control)
+            try:
+                print("pong" if await ctl.ping() else "no reply")
+            finally:
+                await ctl.close()
+        elif args.what == "check":
+            from ..net.grpc_transport import GrpcClient
+
+            client = GrpcClient(own_addr="check")
+            try:
+                ident = await client.get_identity(args.address)
+                ok = ident.valid_signature()
+                print(json.dumps({"address": args.address,
+                                  "key": ident.key.to_bytes().hex(),
+                                  "valid_signature": ok}))
+            finally:
+                await client.close()
+
+    asyncio.run(run())
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="drand-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    g = sub.add_parser("generate-keypair")
+    g.add_argument("address")
+    g.add_argument("--folder")
+    g.add_argument("--tls-disable", action="store_true", default=True)
+    g.add_argument("--force", action="store_true")
+    g.set_defaults(fn=cmd_generate_keypair)
+
+    s = sub.add_parser("start")
+    s.add_argument("--folder")
+    s.add_argument("--private-listen")
+    s.add_argument("--public-listen")
+    s.add_argument("--control", type=int, default=8888)
+    s.add_argument("--dkg-timeout", type=float, default=10.0)
+    s.add_argument("--verbose", action="store_true")
+    s.set_defaults(fn=cmd_start)
+
+    sh = sub.add_parser("share")
+    sh.add_argument("--control", type=int, default=8888)
+    sh.add_argument("--leader", action="store_true")
+    sh.add_argument("--connect")
+    sh.add_argument("--nodes", type=int)
+    sh.add_argument("--threshold", type=int)
+    sh.add_argument("--period", type=int, default=30)
+    sh.add_argument("--secret-file")
+    sh.add_argument("--timeout", type=float, default=60.0)
+    sh.add_argument("--reshare", action="store_true")
+    sh.add_argument("--leaving", action="store_true")
+    sh.add_argument("--from-group", help="old group file (new joiners)")
+    sh.set_defaults(fn=cmd_share)
+
+    f = sub.add_parser("follow")
+    f.add_argument("--control", type=int, default=8888)
+    f.add_argument("--sync-nodes", required=True)
+    f.add_argument("--up-to", type=int, default=0)
+    f.set_defaults(fn=cmd_follow)
+
+    st = sub.add_parser("stop")
+    st.add_argument("--control", type=int, default=8888)
+    st.set_defaults(fn=cmd_stop)
+
+    show = sub.add_parser("show")
+    show.add_argument("what", choices=["share", "group", "chain-info",
+                                       "public", "status"])
+    show.add_argument("--control", type=int, default=8888)
+    show.set_defaults(fn=cmd_show)
+
+    get = sub.add_parser("get")
+    get.add_argument("what", choices=["public", "chain-info"])
+    get.add_argument("--url", required=True)
+    get.add_argument("--round", type=int, default=0)
+    get.set_defaults(fn=cmd_get)
+
+    u = sub.add_parser("util")
+    u.add_argument("what", choices=["ping", "check"])
+    u.add_argument("--control", type=int, default=8888)
+    u.add_argument("--address")
+    u.set_defaults(fn=cmd_util)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

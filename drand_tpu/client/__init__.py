@@ -1,0 +1,108 @@
+"""Composable randomness client.
+
+Reference: client/client.go:21 New / :44 makeClient — the stack built here
+is watch-aggregator(caching(optimizing([verifying(source)…]))), matching
+the reference's layering. Options become keyword arguments of
+:func:`new_client`.
+"""
+
+from __future__ import annotations
+
+from ..chain.info import Info
+from .aggregator import WatchAggregator
+from .cache import CachingClient
+from .direct import DirectClient
+from .interface import Client, ClientError, Result  # noqa: F401
+from .optimizing import OptimizingClient
+from .verify import VerifyingClient
+
+
+def new_client(
+    sources: list[Client],
+    chain_info: Info | None = None,
+    chain_hash: bytes = b"",
+    strict_rounds: bool = False,
+    v1_verification_until: int | None = None,
+    cache_size: int = 256,
+    insecurely: bool = False,
+) -> Client:
+    """Build the verified client stack over one or more sources.
+
+    - ``chain_info`` / ``chain_hash``: the point of trust. One of them is
+      required unless ``insecurely`` (client/client.go:95 trust root rules);
+      with only a hash, the first source's info is fetched and pinned
+      against it at first use.
+    - ``strict_rounds``: verify the full signature chain from the trust
+      point (verify.go getTrustedPreviousSignature).
+    - ``v1_verification_until``: rounds after this verify via the unchained
+      V2 signature (client/client.go:367 WithV1VerificationUntil).
+    """
+    if not sources:
+        raise ValueError("need at least one source")
+    if chain_info is None and not chain_hash and not insecurely:
+        raise ValueError(
+            "a chain hash or chain info is required (or pass insecurely)")
+    if chain_info is not None and chain_hash and \
+            chain_info.hash() != chain_hash:
+        raise ValueError("chain_info does not match the pinned chain_hash")
+    wrapped: list[Client] = [
+        VerifyingClient(_pinned(s, chain_info, chain_hash),
+                        strict_rounds=strict_rounds,
+                        v1_until=v1_verification_until)
+        for s in sources
+    ]
+    inner = wrapped[0] if len(wrapped) == 1 else OptimizingClient(wrapped)
+    return WatchAggregator(CachingClient(inner, size=cache_size))
+
+
+def _pinned(source: Client, info: Info | None, chain_hash: bytes) -> Client:
+    if info is None and not chain_hash:
+        return source
+    return _PinnedClient(source, info, chain_hash)
+
+
+class _PinnedClient(Client):
+    """Enforces the trust root: the source's chain info must match the
+    configured info/hash (client/client.go:95)."""
+
+    def __init__(self, source: Client, info: Info | None, chain_hash: bytes):
+        self._src = source
+        self._info = info
+        self._hash = chain_hash or (info.hash() if info else b"")
+
+    async def info(self) -> Info:
+        if self._info is None:
+            got = await self._src.info()
+            if got.hash() != self._hash:
+                raise ClientError("source chain info does not match "
+                                  "the pinned chain hash")
+            self._info = got
+        return self._info
+
+    async def get(self, round_no: int = 0) -> Result:
+        await self.info()
+        return await self._src.get(round_no)
+
+    async def watch(self):
+        await self.info()
+        async for r in self._src.watch():
+            yield r
+
+    def round_at(self, t: float) -> int:
+        return self._src.round_at(t)
+
+    async def close(self) -> None:
+        await self._src.close()
+
+
+__all__ = [
+    "CachingClient",
+    "Client",
+    "ClientError",
+    "DirectClient",
+    "OptimizingClient",
+    "Result",
+    "VerifyingClient",
+    "WatchAggregator",
+    "new_client",
+]

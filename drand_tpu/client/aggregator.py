@@ -1,0 +1,66 @@
+"""Watch aggregation: one upstream watch fanned out to N subscribers.
+
+Reference: client/aggregator.go:26 newWatchAggregator — subscribers come
+and go; the single upstream subscription starts with the first subscriber
+and stops with the last.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from .interface import Client, Result
+
+
+class WatchAggregator(Client):
+    def __init__(self, source: Client):
+        self._src = source
+        self._subs: list[asyncio.Queue] = []
+        self._pump: asyncio.Task | None = None
+
+    async def get(self, round_no: int = 0) -> Result:
+        return await self._src.get(round_no)
+
+    async def info(self):
+        return await self._src.info()
+
+    def round_at(self, t: float) -> int:
+        return self._src.round_at(t)
+
+    async def watch(self):
+        q: asyncio.Queue = asyncio.Queue(maxsize=32)
+        self._subs.append(q)
+        if self._pump is None or self._pump.done():
+            self._pump = asyncio.ensure_future(self._run())
+        try:
+            while True:
+                yield await q.get()
+        finally:
+            self._subs.remove(q)
+            if not self._subs and self._pump is not None:
+                self._pump.cancel()
+                self._pump = None
+
+    async def _run(self) -> None:
+        """Pump upstream rounds to subscribers; survives upstream watch
+        failures/end-of-stream by resubscribing (a dead pump would hang
+        every subscriber forever)."""
+        while True:
+            try:
+                async for r in self._src.watch():
+                    for q in list(self._subs):
+                        try:
+                            q.put_nowait(r)
+                        except asyncio.QueueFull:
+                            pass  # slow subscriber skips rounds
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — retry upstream
+                pass
+            await asyncio.sleep(1.0)
+
+    async def close(self) -> None:
+        if self._pump is not None:
+            self._pump.cancel()
+            self._pump = None
+        await self._src.close()

@@ -1,0 +1,46 @@
+"""Caching client: LRU of verified rounds (reference client/cache.go:22
+makeCache/NewCachingClient — ARC there, LRU here; the eviction policy is
+not part of the behavior contract)."""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from .interface import Client, Result
+
+
+class CachingClient(Client):
+    def __init__(self, source: Client, size: int = 256):
+        self._src = source
+        self._size = size
+        self._cache: OrderedDict[int, Result] = OrderedDict()
+
+    async def get(self, round_no: int = 0) -> Result:
+        if round_no:
+            hit = self._cache.get(round_no)
+            if hit is not None:
+                self._cache.move_to_end(round_no)
+                return hit
+        r = await self._src.get(round_no)
+        self._remember(r)
+        return r
+
+    def _remember(self, r: Result) -> None:
+        self._cache[r.round] = r
+        self._cache.move_to_end(r.round)
+        while len(self._cache) > self._size:
+            self._cache.popitem(last=False)
+
+    async def watch(self):
+        async for r in self._src.watch():
+            self._remember(r)
+            yield r
+
+    async def info(self):
+        return await self._src.info()
+
+    def round_at(self, t: float) -> int:
+        return self._src.round_at(t)
+
+    async def close(self) -> None:
+        await self._src.close()

@@ -1,0 +1,55 @@
+"""Direct in-process source: reads a running node's chain store.
+
+The in-process analogue of the reference's gRPC public client
+(client/grpc/client.go:30): the REST server (http_server/) and tests both
+consume a node this way; the network clients (client/http.py) expose the
+same surface over the wire.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..chain import time_math
+from ..chain.info import Info
+from .interface import Client, ClientError, Result, result_from_beacon
+
+
+class DirectClient(Client):
+    """Wraps a beacon Handler (chain store + chain info)."""
+
+    def __init__(self, handler):
+        self._h = handler
+
+    async def get(self, round_no: int = 0) -> Result:
+        store = self._h.chain
+        b = store.last() if round_no == 0 else store.get(round_no)
+        if b is None:
+            raise ClientError(f"round {round_no} not in chain")
+        if round_no == 0 and b.round == 0:
+            raise ClientError("chain has no rounds yet")
+        return result_from_beacon(b)
+
+    async def watch(self):
+        q: asyncio.Queue = asyncio.Queue(maxsize=32)
+        cb_id = f"client-watch-{id(q)}"
+
+        def _cb(b) -> None:
+            try:
+                q.put_nowait(result_from_beacon(b))
+            except asyncio.QueueFull:
+                pass
+
+        self._h.chain.add_callback(cb_id, _cb)
+        try:
+            while True:
+                yield await q.get()
+        finally:
+            self._h.chain.remove_callback(cb_id)
+
+    async def info(self) -> Info:
+        return self._h.crypto.chain_info
+
+    def round_at(self, t: float) -> int:
+        info = self._h.crypto.chain_info
+        return time_math.current_round(int(t), info.period, info.genesis_time)

@@ -1,0 +1,103 @@
+"""HTTP client source: consumes the public REST API.
+
+Reference: client/http/http.go (New :29, Get :248, Watch :300 via
+PollingWatcher, poll.go:13). Speaks the same JSON wire format as
+http_server/server.py and the reference's public endpoints.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import aiohttp
+
+from ..chain import time_math
+from ..chain.info import Info
+from ..crypto.curves import PointG1
+from ..utils.clock import Clock, SystemClock
+from .interface import Client, ClientError, Result
+
+
+def result_from_json(d: dict) -> Result:
+    try:
+        return Result(
+            round=int(d["round"]),
+            signature=bytes.fromhex(d.get("signature", "")),
+            previous_signature=bytes.fromhex(d.get("previous_signature", "")),
+            signature_v2=bytes.fromhex(d.get("signature_v2", "")),
+            randomness=bytes.fromhex(d.get("randomness", "")),
+        )
+    except (KeyError, ValueError, TypeError) as e:
+        # a ClientError keeps the optimizing client's failover working
+        raise ClientError(f"malformed beacon JSON: {e!r}") from e
+
+
+class HTTPClient(Client):
+    def __init__(self, base_url: str, clock: Clock | None = None,
+                 timeout: float = 10.0):
+        self._base = base_url.rstrip("/")
+        self._clock = clock or SystemClock()
+        self._timeout = aiohttp.ClientTimeout(total=timeout)
+        self._session: aiohttp.ClientSession | None = None
+        self._info: Info | None = None
+
+    async def _sess(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(timeout=self._timeout)
+        return self._session
+
+    async def _get_json(self, path: str) -> dict:
+        sess = await self._sess()
+        try:
+            async with sess.get(self._base + path) as resp:
+                body = await resp.json()
+                if resp.status != 200:
+                    raise ClientError(
+                        f"GET {path}: {resp.status} {body.get('error', '')}")
+                return body
+        except aiohttp.ClientError as e:
+            raise ClientError(f"GET {path}: {e!r}") from e
+
+    # ------------------------------------------------------------- Client
+    async def get(self, round_no: int = 0) -> Result:
+        path = "/public/latest" if round_no == 0 else f"/public/{round_no}"
+        return result_from_json(await self._get_json(path))
+
+    async def watch(self):
+        """Poll for each upcoming round (client/http/poll.go:13): sleep to
+        the next round boundary, then long-poll GET it."""
+        info = await self.info()
+        while True:
+            now = self._clock.now()
+            next_round, next_time = time_math.next_round(
+                int(now), info.period, info.genesis_time)
+            await self._clock.sleep(max(0.0, next_time - now))
+            try:
+                yield await self.get(next_round)
+            except ClientError:
+                # missed it (node lagging); try the next boundary
+                await self._clock.sleep(min(1.0, info.period / 10))
+
+    async def info(self) -> Info:
+        if self._info is None:
+            d = await self._get_json("/info")
+            group_hash = bytes.fromhex(d.get("group_hash", ""))
+            self._info = Info(
+                public_key=PointG1.from_bytes(bytes.fromhex(d["public_key"])),
+                period=d["period"],
+                genesis_time=d["genesis_time"],
+                # reference semantics: group_hash IS the genesis seed
+                genesis_seed=group_hash,
+                group_hash=group_hash,
+            )
+        return self._info
+
+    def round_at(self, t: float) -> int:
+        if self._info is None:
+            raise ClientError("info not fetched yet")
+        return time_math.current_round(int(t), self._info.period,
+                                       self._info.genesis_time)
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()

@@ -1,0 +1,58 @@
+"""Client interfaces: Result and the composable Client contract.
+
+Reference: client/interface.go (Client :13, Result :37). A Client yields
+Results; layered implementations (verifying, caching, optimizing,
+aggregating — client/client.go:44 makeClient) wrap an underlying source.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import AsyncIterator
+
+from ..chain.info import Info
+
+
+class ClientError(Exception):
+    pass
+
+
+@dataclass
+class Result:
+    """One round of randomness (client/interface.go:37)."""
+
+    round: int
+    signature: bytes
+    previous_signature: bytes = b""
+    signature_v2: bytes = b""
+    randomness: bytes = b""
+
+    def __post_init__(self):
+        if not self.randomness and self.signature:
+            self.randomness = hashlib.sha256(self.signature).digest()
+
+
+class Client:
+    """Async client contract. ``get(0)`` means the latest round."""
+
+    async def get(self, round_no: int = 0) -> Result:
+        raise NotImplementedError
+
+    def watch(self) -> AsyncIterator[Result]:
+        raise NotImplementedError
+
+    async def info(self) -> Info:
+        raise NotImplementedError
+
+    def round_at(self, t: float) -> int:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        pass
+
+
+def result_from_beacon(b) -> Result:
+    return Result(round=b.round, signature=b.signature,
+                  previous_signature=b.previous_sig,
+                  signature_v2=b.signature_v2)

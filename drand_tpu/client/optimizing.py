@@ -1,0 +1,100 @@
+"""Optimizing client: race multiple sources, prefer the fastest, demote
+failing endpoints.
+
+Reference: client/optimizing.go (newOptimizingClient :52, Get :231,
+testSpeed :170, Watch :398): sources are tried in speed order; a failure
+pushes a source to the back; periodic speed tests re-rank.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from ..utils.logging import KVLogger, default_logger
+from .interface import Client, ClientError, Result
+
+SPEED_TEST_INTERVAL = 300.0
+
+
+class OptimizingClient(Client):
+    def __init__(self, sources: list[Client], request_timeout: float = 5.0,
+                 logger: KVLogger | None = None):
+        if not sources:
+            raise ValueError("optimizing client needs at least one source")
+        self._sources = list(sources)
+        self._timeout = request_timeout
+        self._l = logger or default_logger("client.optimizing")
+        self._last_ranked = 0.0
+
+    # ------------------------------------------------------------- Client
+    async def get(self, round_no: int = 0) -> Result:
+        await self._maybe_rank()
+        last_err: Exception | None = None
+        for src in list(self._sources):
+            try:
+                return await asyncio.wait_for(src.get(round_no),
+                                              self._timeout)
+            except (ClientError, asyncio.TimeoutError, OSError) as e:
+                last_err = e
+                self._demote(src)
+        raise ClientError(f"all sources failed: {last_err!r}")
+
+    async def watch(self):
+        """Watch the current best source; on failure, fail over to the
+        next and continue from there (optimizing.go:398)."""
+        while True:
+            src = self._sources[0]
+            try:
+                async for r in src.watch():
+                    yield r
+                return
+            except (ClientError, OSError) as e:
+                self._l.warn("optimizing", "watch_failover", err=str(e))
+                self._demote(src)
+                await asyncio.sleep(0.5)
+
+    async def info(self):
+        for src in list(self._sources):
+            try:
+                return await asyncio.wait_for(src.info(), self._timeout)
+            except (ClientError, asyncio.TimeoutError, OSError):
+                self._demote(src)
+        raise ClientError("all sources failed for info")
+
+    def round_at(self, t: float) -> int:
+        return self._sources[0].round_at(t)
+
+    async def close(self) -> None:
+        for src in self._sources:
+            await src.close()
+
+    # ----------------------------------------------------------- ranking
+    def _demote(self, src: Client) -> None:
+        if src in self._sources and len(self._sources) > 1:
+            self._sources.remove(src)
+            self._sources.append(src)
+
+    async def _maybe_rank(self) -> None:
+        """Kick a BACKGROUND speed test when due (optimizing.go:170 runs
+        them in a goroutine) — foreground requests never pay for probing
+        slow sources."""
+        now = time.monotonic()
+        if now - self._last_ranked < SPEED_TEST_INTERVAL or \
+                len(self._sources) == 1:
+            return
+        self._last_ranked = now
+        asyncio.ensure_future(self._rank())
+
+    async def _rank(self) -> None:
+        async def probe(src: Client) -> tuple[float, Client]:
+            t0 = time.monotonic()
+            try:
+                await asyncio.wait_for(src.get(0), self._timeout)
+                return (time.monotonic() - t0, src)
+            except (ClientError, asyncio.TimeoutError, OSError):
+                return (float("inf"), src)
+
+        timings = await asyncio.gather(*(probe(s) for s in list(self._sources)))
+        order = sorted(timings, key=lambda p: p[0])
+        self._sources = [s for _, s in order]

@@ -1,0 +1,43 @@
+"""Daemon configuration.
+
+Reference: core/config.go (Config :20, NewConfig :44, options :60-230) and
+core/constants.go (default period :27, DKG timeout :36, control port :30).
+Python keyword arguments replace Go's functional options.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..chain.beacon import Beacon
+from ..utils.clock import Clock, SystemClock
+
+DEFAULT_BEACON_PERIOD = 60          # core/constants.go:27
+DEFAULT_DKG_TIMEOUT = 10.0          # core/constants.go:36 (per phase)
+DEFAULT_CONTROL_PORT = 8888         # core/constants.go:30
+DEFAULT_GENESIS_OFFSET = 20         # group_setup.go: genesis placed beyond
+                                    # 3 DKG phases + offset
+
+
+@dataclass
+class Config:
+    folder: str = ""                      # key/group/chain storage root
+    private_listen: str = ""              # host:port for node->node RPC
+    public_listen: str = ""               # host:port for the public REST API
+    control_port: int = DEFAULT_CONTROL_PORT
+    dkg_timeout: float = DEFAULT_DKG_TIMEOUT
+    clock: Clock = field(default_factory=SystemClock)
+    beacon_callbacks: list[Callable[[Beacon], None]] = field(default_factory=list)
+    dkg_callback: Callable | None = None
+    db_path: str = ""                     # beacon chain store path; "" = memory
+    insecure: bool = False                # no TLS (reference --tls-disable)
+
+    def db_file(self) -> str:
+        if self.db_path:
+            return self.db_path
+        if self.folder:
+            import os
+
+            return os.path.join(self.folder, "db", "drand.db")
+        return ""

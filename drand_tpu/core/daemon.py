@@ -1,0 +1,423 @@
+"""The drand daemon: node lifecycle, DKG orchestration, beacon control.
+
+Reference: core/drand.go (Drand :25, NewDrand :68, LoadDrand :144, WaitDKG
+:166, StartBeacon :220, transition :243) and core/drand_control.go (InitDKG
+:33, leaderRunSetup :72, runDKG :123, runResharing :196, setupAutomaticDKG
+:291, InitReshare :500, pushDKGInfo :712).
+
+A Drand instance implements the node->node ProtocolService; callers
+register it on a transport (LocalNetwork in-process, gRPC gateway across
+hosts) and drive it through the control methods (`init_dkg`,
+`init_reshare`, `stop`) that the CLI/control plane exposes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import AsyncIterator
+
+from ..chain.beacon import Beacon
+from ..chain.engine.handler import BeaconConfig, Handler
+from ..chain.store import MemStore, SQLiteStore, Store
+from ..dkg import BroadcastBoard, DKGConfig, DKGError, DKGProtocol, DistKeyShare
+from ..key.group import Group
+from ..key.keys import Node, Pair, Share
+from ..key.store import FileStore
+from ..net.packets import (
+    GroupPacket,
+    PartialBeaconPacket,
+    SignalDKGPacket,
+    SyncRequest,
+)
+from ..net.transport import ProtocolClient, ProtocolService, TransportError
+from ..utils.logging import KVLogger, default_logger
+from .config import Config
+from .setup import (
+    SetupConfig,
+    SetupManager,
+    check_secret,
+    dkg_nonce,
+    sign_group,
+    verify_group_packet,
+)
+
+
+class DrandError(Exception):
+    pass
+
+
+class Drand(ProtocolService):
+    def __init__(self, key_store: FileStore | None, conf: Config,
+                 client: ProtocolClient, priv: Pair,
+                 logger: KVLogger | None = None):
+        self.store = key_store
+        self.conf = conf
+        self.client = client
+        self.priv = priv
+        self._l = (logger or default_logger("drand")).named(
+            priv.public.addr.split(":")[0])
+        self.group: Group | None = None
+        self.share: Share | None = None
+        self.beacon: Handler | None = None
+        # DKG-in-progress state
+        self._setup_mgr: SetupManager | None = None
+        self._board: BroadcastBoard | None = None
+        # bundles that raced ahead of board creation (a dealer can push its
+        # deals before a follower finished processing the group push);
+        # replayed into the board once the DKG starts
+        self._pending_dkg: list[tuple[str, object]] = []
+        self._group_packet: asyncio.Future | None = None
+        self._expected_secret: bytes | None = None
+        self._stopped = False
+
+    # ------------------------------------------------------------ factory
+    @classmethod
+    def fresh(cls, key_store: FileStore, conf: Config,
+              client: ProtocolClient, address: str,
+              logger: KVLogger | None = None) -> "Drand":
+        """New node: create + persist a keypair (core/drand.go:68)."""
+        from ..key.keys import new_key_pair
+
+        priv = new_key_pair(address)
+        key_store.save_key_pair(priv)
+        return cls(key_store, conf, client, priv, logger)
+
+    @classmethod
+    def load(cls, key_store: FileStore, conf: Config,
+             client: ProtocolClient,
+             logger: KVLogger | None = None) -> "Drand":
+        """Restart: load keypair + share + group; caller then invokes
+        ``start_beacon(catchup=True)`` (core/drand.go:144, daemon.go:36)."""
+        priv = key_store.load_key_pair()
+        d = cls(key_store, conf, client, priv, logger)
+        if key_store.has_group():
+            d.group = key_store.load_group()
+        if key_store.has_share():
+            d.share = key_store.load_share()
+        return d
+
+    # ----------------------------------------------------- control plane
+    async def init_dkg_leader(self, expected_n: int, threshold: int,
+                              period: int, secret: bytes,
+                              timeout: float = 60.0,
+                              catchup_period: int = 0) -> Group:
+        """Leader: collect participants, push the group, run the DKG,
+        start the beacon (InitDKG :33 + leaderRunSetup :72)."""
+        sc = SetupConfig(expected_n=expected_n, threshold=threshold,
+                         period=period, secret=secret,
+                         catchup_period=catchup_period,
+                         dkg_timeout=self.conf.dkg_timeout)
+        self._setup_mgr = SetupManager(sc, self.priv.public, self.conf.clock,
+                                       self._l.named("setup"))
+        try:
+            idents = await self._setup_mgr.wait_participants(timeout)
+        finally:
+            mgr, self._setup_mgr = self._setup_mgr, None
+        group = mgr.make_group(idents)
+        await self._push_group(group, secret)
+        result = await self._run_dkg(group)
+        return await self._adopt_dkg_output(group, result, fresh=True)
+
+    async def init_dkg_follower(self, leader: Node | str, secret: bytes,
+                                timeout: float = 60.0) -> Group:
+        """Follower: signal the leader, await the signed group, run the DKG
+        (setupAutomaticDKG :291)."""
+        self._expected_secret = secret
+        self._group_packet = asyncio.get_event_loop().create_future()
+        await self._signal_leader(leader, secret, b"", timeout)
+        packet, leader_ident = await asyncio.wait_for(
+            self._group_packet, timeout)
+        group = verify_group_packet(leader_ident, packet)
+        if group.find(self.priv.public) is None:
+            raise DrandError("we are not part of the pushed group")
+        result = await self._run_dkg(group)
+        return await self._adopt_dkg_output(group, result, fresh=True)
+
+    async def init_reshare_leader(self, expected_n: int, threshold: int,
+                                  secret: bytes, timeout: float = 60.0) -> Group:
+        """Leader of a resharing epoch; must hold the old group+share
+        (InitReshare :500)."""
+        old_group, old_share = self._require_running()
+        sc = SetupConfig(expected_n=expected_n, threshold=threshold,
+                         period=old_group.period, secret=secret,
+                         dkg_timeout=self.conf.dkg_timeout)
+        self._setup_mgr = SetupManager(sc, self.priv.public, self.conf.clock,
+                                       self._l.named("setup"))
+        try:
+            idents = await self._setup_mgr.wait_participants(timeout)
+        finally:
+            mgr, self._setup_mgr = self._setup_mgr, None
+        group = mgr.make_group(idents, old_group=old_group)
+        # push to the union of old and new members so leavers learn too
+        await self._push_group(group, secret, extra=old_group.nodes)
+        result = await self._run_dkg(group, old_group=old_group,
+                                     old_share=old_share)
+        return await self._transition(old_group, group, result)
+
+    async def init_reshare_follower(self, leader: Node | str, secret: bytes,
+                                    old_group: Group | None = None,
+                                    leaving: bool = False,
+                                    timeout: float = 60.0) -> Group:
+        """Existing member, new joiner, or leaver in a resharing epoch
+        (setupAutomaticResharing :371). New joiners pass the old group file
+        (they need its public coefficients); members use their stored one.
+        A leaver sets ``leaving=True``: it does NOT signal (signalling joins
+        the new group) but still deals its old share and stops at T."""
+        if old_group is None:
+            old_group = self.group
+        if old_group is None:
+            raise DrandError("resharing needs the old group file")
+        self._expected_secret = secret
+        self._group_packet = asyncio.get_event_loop().create_future()
+        if not leaving:
+            await self._signal_leader(leader, secret, old_group.hash(), timeout)
+        packet, leader_ident = await asyncio.wait_for(
+            self._group_packet, timeout)
+        group = verify_group_packet(leader_ident, packet)
+        if old_group.find(leader_ident) is None:
+            raise DrandError("reshare leader not part of the old group")
+        result = await self._run_dkg(group, old_group=old_group,
+                                     old_share=self.share)
+        return await self._transition(old_group, group, result)
+
+    def start_beacon(self, catchup: bool = True) -> None:
+        """Boot the beacon from persisted state (core/drand.go:220)."""
+        group, share = self._require_loaded()
+        self._make_handler(group, share)
+        if catchup:
+            asyncio.ensure_future(self.beacon.catchup())
+        else:
+            asyncio.ensure_future(self.beacon.start())
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self.beacon is not None:
+            self.beacon.stop()
+
+    async def follow_chain(self, peers: list[str], up_to: int = 0) -> bool:
+        """Sync the chain from peers without participating
+        (core/drand_control.go:783 StartFollowChain): fetch+pin the chain
+        info, then stream/verify/store beacons."""
+        from ..chain.engine.sync import Syncer
+        from ..chain.store import CallbackStore, genesis_beacon
+
+        if not peers:
+            raise DrandError("follow needs at least one peer")
+        info = None
+        for p in peers:
+            try:
+                info = await self.client.chain_info(_addr_peer(p))
+                break
+            except TransportError:
+                continue
+        if info is None:
+            raise DrandError("no peer served chain info")
+        db = self.conf.db_file()
+        if db:
+            os.makedirs(os.path.dirname(db), exist_ok=True)
+            store: Store = SQLiteStore(db)
+        else:
+            store = MemStore()
+        store.put(genesis_beacon(info))
+        cb_store = CallbackStore(store)
+        syncer = Syncer(self._l.named("follow"), cb_store, info, self.client)
+        self._follow_store = cb_store  # kept for status/resume inspection
+        return await syncer.follow(up_to, [_addr_peer(p) for p in peers])
+
+    # ------------------------------------------------------- DKG internals
+    async def _signal_leader(self, leader, secret: bytes, prev_hash: bytes,
+                             timeout: float, retry_every: float = 0.5) -> None:
+        packet = SignalDKGPacket(identity=self.priv.public, secret=secret,
+                                 previous_group_hash=prev_hash)
+        deadline = self.conf.clock.now() + timeout
+        while True:
+            try:
+                await self.client.signal_dkg_participant(leader, packet)
+                return
+            except (TransportError, PermissionError):
+                if self.conf.clock.now() >= deadline:
+                    raise
+                await self.conf.clock.sleep(retry_every)
+
+    async def _push_group(self, group: Group, secret: bytes,
+                          extra: list[Node] | None = None) -> None:
+        """Sign + deliver the group to every other member; require a
+        threshold of successful pushes (pushDKGInfo :712-770)."""
+        packet = GroupPacket(group=group.to_dict(),
+                             signature=sign_group(self.priv.key, group),
+                             secret=secret,
+                             dkg_timeout=self.conf.dkg_timeout)
+        targets: dict[str, Node] = {n.address(): n for n in group.nodes}
+        for n in extra or []:
+            targets.setdefault(n.address(), n)
+        targets.pop(self.priv.public.addr, None)
+        oks = 0
+        for node in targets.values():
+            try:
+                await self.client.push_dkg_info(node.identity, packet)
+                oks += 1
+            except (TransportError, Exception) as e:  # noqa: BLE001
+                self._l.warn("push_group", "failed", to=node.address(),
+                             err=str(e))
+        if oks + 1 < group.threshold:
+            raise DrandError(
+                f"group push reached only {oks + 1} < threshold "
+                f"{group.threshold}")
+
+    async def _run_dkg(self, group: Group, old_group: Group | None = None,
+                       old_share: Share | None = None) -> DistKeyShare:
+        nonce = dkg_nonce(group)
+        dealers = old_group.nodes if old_group is not None else group.nodes
+        self._board = BroadcastBoard(
+            self.client, self.priv.public.addr, dealers, group.nodes, nonce,
+            self._l.named("board"))
+        pending, self._pending_dkg = self._pending_dkg, []
+        for from_addr, pkt in pending:
+            await self._board.receive(from_addr, pkt)
+        try:
+            conf = DKGConfig(
+                longterm=self.priv, nonce=nonce, new_nodes=group.nodes,
+                threshold=group.threshold,
+                old_nodes=old_group.nodes if old_group else None,
+                public_coeffs=(old_group.public_key.coefficients
+                               if old_group else None),
+                old_threshold=old_group.threshold if old_group else 0,
+                share=(old_share.pri_share if old_share else None),
+                fast_sync=True, phase_timeout=self.conf.dkg_timeout,
+                clock=self.conf.clock, logger=self._l)
+            result = await DKGProtocol(conf, self._board).run()
+        finally:
+            self._board = None
+        if self.conf.dkg_callback is not None:
+            self.conf.dkg_callback(result)
+        return result
+
+    async def _adopt_dkg_output(self, group: Group, result: DistKeyShare,
+                                fresh: bool) -> Group:
+        from ..key.keys import DistPublic
+
+        group.public_key = DistPublic(list(result.commits))
+        self.group = group
+        self.share = Share(commits=list(result.commits),
+                           pri_share=result.pri_share)
+        if self.store is not None:
+            self.store.save_group(group)
+            self.store.save_share(self.share)
+        self._make_handler(group, self.share)
+        asyncio.ensure_future(self.beacon.start())
+        self._l.info("dkg", "finished", qual=result.qual,
+                     genesis=group.genesis_time)
+        return group
+
+    async def _transition(self, old_group: Group, new_group: Group,
+                          result: DistKeyShare) -> Group:
+        """Post-reshare transition (core/drand.go:243-277): members swap
+        shares at T-1, joiners sync then start at T, leavers stop at T."""
+        was_member = old_group.find(self.priv.public) is not None
+        is_member = result.pri_share is not None
+        if self.store is not None and is_member:
+            new_share = Share(commits=list(result.commits),
+                              pri_share=result.pri_share)
+            self.store.save_group(new_group)
+            self.store.save_share(new_share)
+        if not is_member:
+            # leaving: stop right before the transition round fires
+            if self.beacon is not None:
+                asyncio.ensure_future(
+                    self.beacon.stop_at(new_group.transition_time - 1))
+            self._l.info("reshare", "leaving_at",
+                         t=new_group.transition_time)
+            self.group = new_group
+            return new_group
+        new_share = Share(commits=list(result.commits),
+                          pri_share=result.pri_share)
+        if was_member and self.beacon is not None:
+            self.beacon.transition_new_group(new_share, new_group)
+        else:
+            self._make_handler(new_group, new_share)
+            asyncio.ensure_future(self.beacon.transition(old_group))
+        self.group, self.share = new_group, new_share
+        return new_group
+
+    # --------------------------------------------------- beacon plumbing
+    def _make_handler(self, group: Group, share: Share) -> None:
+        node = group.find(self.priv.public)
+        if node is None:
+            raise DrandError("keypair not in group")
+        db = self.conf.db_file()
+        if db:
+            os.makedirs(os.path.dirname(db), exist_ok=True)
+            store: Store = SQLiteStore(db)
+        else:
+            store = MemStore()
+        bconf = BeaconConfig(public=Node(identity=self.priv.public,
+                                         index=node.index),
+                             share=share, group=group, clock=self.conf.clock)
+        self.beacon = Handler(client=self.client, store=store, conf=bconf,
+                              logger=self._l.named("beacon"))
+        for cb in self.conf.beacon_callbacks:
+            self.beacon.chain.add_callback(f"conf-{id(cb)}", cb)
+
+    def _require_loaded(self) -> tuple[Group, Share]:
+        if self.group is None or self.share is None:
+            raise DrandError("no group/share loaded")
+        return self.group, self.share
+
+    def _require_running(self) -> tuple[Group, Share]:
+        group, share = self._require_loaded()
+        return group, share
+
+    # ------------------------------------------------- ProtocolService in
+    async def process_partial_beacon(self, from_addr: str,
+                                     p: PartialBeaconPacket) -> None:
+        if self.beacon is None:
+            raise TransportError("no beacon running")
+        await self.beacon.process_partial_beacon(from_addr, p)
+
+    def sync_chain(self, from_addr: str, req: SyncRequest) -> AsyncIterator[Beacon]:
+        if self.beacon is None:
+            raise TransportError("no beacon running")
+        return self.beacon.sync_chain(from_addr, req)
+
+    async def chain_info(self, from_addr: str):
+        if self.beacon is not None:
+            return await self.beacon.chain_info(from_addr)
+        if self.group is not None and self.group.public_key is not None:
+            from ..chain.info import Info
+
+            return Info.from_group(self.group)
+        raise TransportError("no chain info yet")
+
+    async def get_identity(self, from_addr: str):
+        return self.priv.public
+
+    async def signal_dkg_participant(self, from_addr: str,
+                                     packet: SignalDKGPacket) -> None:
+        if self._setup_mgr is None:
+            raise TransportError("no setup in progress")
+        self._setup_mgr.received_key(from_addr, packet)
+
+    async def push_dkg_info(self, from_addr: str, packet: GroupPacket) -> None:
+        if self._group_packet is None or self._group_packet.done():
+            raise TransportError("not expecting a group push")
+        if self._expected_secret is None or \
+                not check_secret(self._expected_secret, packet.secret):
+            raise TransportError("push group: wrong secret")
+        leader_ident = await self.client.get_identity(_addr_peer(from_addr))
+        self._group_packet.set_result((packet, leader_ident))
+
+    async def broadcast_dkg(self, from_addr: str, packet) -> None:
+        if self._board is None:
+            if len(self._pending_dkg) < 1024:
+                self._pending_dkg.append((from_addr, packet))
+                return
+            raise TransportError("no DKG in progress")
+        await self._board.receive(from_addr, packet)
+
+
+class _addr_peer(str):
+    """Minimal Peer: an address string with .address()."""
+
+    def address(self) -> str:
+        return str(self)

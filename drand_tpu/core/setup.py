@@ -1,0 +1,130 @@
+"""Group setup: leader collects participants, forms and distributes the
+signed group file.
+
+Reference: core/group_setup.go — setupManager (:42) gathers
+SignalDKGParticipant keys gated by a shared secret (constant-time compare
+:369), creates the group with an aligned genesis/transition time
+(:218-242), and PushDKGInfo (:319) delivers it under the leader's
+DKGAuthScheme (schnorr) signature.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import hmac
+from dataclasses import dataclass
+
+from ..crypto import schnorr
+from ..key.group import Group
+from ..key.keys import DistPublic, Identity, Node
+from ..net.packets import GroupPacket, SignalDKGPacket
+from ..utils.clock import Clock
+from ..utils.logging import KVLogger
+from .config import DEFAULT_GENESIS_OFFSET
+
+
+def dkg_nonce(group: Group) -> bytes:
+    """Session nonce binding DKG bundles to this exact group epoch."""
+    h = hashlib.sha256()
+    h.update(b"drand-tpu-dkg-nonce")
+    h.update(group.hash())
+    h.update(int(group.transition_time).to_bytes(8, "big", signed=True))
+    return h.digest()
+
+
+def check_secret(expected: bytes, got: bytes) -> bool:
+    return hmac.compare_digest(expected, got)
+
+
+@dataclass
+class SetupConfig:
+    expected_n: int
+    threshold: int
+    period: int
+    secret: bytes
+    catchup_period: int = 0
+    dkg_timeout: float = 10.0
+    genesis_offset: int = DEFAULT_GENESIS_OFFSET
+
+
+class SetupManager:
+    """Leader-side participant collection (one setup at a time)."""
+
+    def __init__(self, conf: SetupConfig, leader_identity: Identity,
+                 clock: Clock, logger: KVLogger):
+        self.conf = conf
+        self.clock = clock
+        self._l = logger
+        self._identities: dict[str, Identity] = {
+            leader_identity.addr: leader_identity}
+        self._done: asyncio.Future = asyncio.get_event_loop().create_future()
+
+    def received_key(self, from_addr: str, packet: SignalDKGPacket) -> None:
+        """SignalDKGParticipant ingress (group_setup.go:140)."""
+        if not check_secret(self.conf.secret, packet.secret):
+            raise PermissionError("setup: wrong secret")
+        ident = packet.identity
+        if not ident.valid_signature():
+            raise ValueError("setup: invalid identity self-signature")
+        if ident.addr not in self._identities:
+            self._identities[ident.addr] = ident
+            self._l.info("setup", "participant", addr=ident.addr,
+                         have=len(self._identities), want=self.conf.expected_n)
+        if len(self._identities) == self.conf.expected_n and \
+                not self._done.done():
+            self._done.set_result(None)
+
+    async def wait_participants(self, timeout: float) -> list[Identity]:
+        try:
+            await asyncio.wait_for(asyncio.shield(self._done), timeout)
+        except asyncio.TimeoutError:
+            raise TimeoutError(
+                f"setup: only {len(self._identities)} of "
+                f"{self.conf.expected_n} participants signalled")
+        return sorted(self._identities.values(), key=lambda i: i.addr)
+
+    def make_group(self, identities: list[Identity],
+                   old_group: Group | None = None,
+                   public_key: DistPublic | None = None) -> Group:
+        """Form the group; genesis (or transition) is placed after the DKG's
+        three phases and aligned to a period boundary (group_setup.go:218)."""
+        nodes = [Node(identity=ident, index=i)
+                 for i, ident in enumerate(identities)]
+        earliest = int(self.clock.now()) + int(3 * self.conf.dkg_timeout) + \
+            self.conf.genesis_offset
+        if old_group is None:
+            genesis = earliest
+            group = Group(nodes=nodes, threshold=self.conf.threshold,
+                          period=self.conf.period, genesis_time=genesis,
+                          catchup_period=self.conf.catchup_period)
+            group.get_genesis_seed()
+            return group
+        # reshare: keep chain identity; transition on a round boundary
+        period = old_group.period
+        from ..chain import time_math
+
+        t_round = time_math.current_round(earliest, period,
+                                          old_group.genesis_time) + 1
+        t_time = time_math.time_of_round(period, old_group.genesis_time,
+                                         t_round)
+        group = Group(nodes=nodes, threshold=self.conf.threshold,
+                      period=period, genesis_time=old_group.genesis_time,
+                      genesis_seed=old_group.get_genesis_seed(),
+                      transition_time=t_time,
+                      catchup_period=old_group.catchup_period,
+                      public_key=public_key or old_group.public_key)
+        return group
+
+
+def sign_group(leader_key: int, group: Group) -> bytes:
+    return schnorr.sign(leader_key, group.hash())
+
+
+def verify_group_packet(leader: Identity, packet: GroupPacket) -> Group:
+    """Follower side: parse + verify the leader-signed group
+    (group_setup.go:319-339 setupReceiver.PushDKGInfo)."""
+    group = Group.from_dict(packet.group)
+    if not schnorr.verify(leader.key, group.hash(), packet.signature):
+        raise ValueError("push group: invalid leader signature")
+    return group

@@ -1,0 +1,168 @@
+"""Public REST API server.
+
+Reference: http/server.go (New :35, routes :52-55, long-poll watch :102,
+health :55,:351). JSON wire format matches the reference's public API so
+existing drand consumers can point at this server unchanged:
+
+    GET /public/latest   -> {"round","randomness","signature",
+                             "previous_signature"[,"signature_v2"]}
+    GET /public/{round}  -> same (long-polls if the round is the next one)
+    GET /info            -> {"public_key","period","genesis_time",
+                             "group_hash","hash"}
+    GET /health          -> 200 {"current","expected"} | 500 when lagging
+
+Serving stack: aiohttp over any client.Client (typically a DirectClient on
+the local daemon, or a verifying client over remote nodes — the reference
+relays this same way, cmd/relay).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from aiohttp import web
+
+from ..chain import time_math
+from ..client.interface import Client, ClientError, Result
+from ..utils.clock import Clock, SystemClock
+from ..utils.logging import KVLogger, default_logger
+
+
+def result_json(r: Result) -> dict:
+    d = {
+        "round": r.round,
+        "randomness": r.randomness.hex(),
+        "signature": r.signature.hex(),
+        "previous_signature": r.previous_signature.hex(),
+    }
+    if r.signature_v2:
+        d["signature_v2"] = r.signature_v2.hex()
+    return d
+
+
+class PublicServer:
+    def __init__(self, client: Client, clock: Clock | None = None,
+                 logger: KVLogger | None = None,
+                 watch_timeout: float = 30.0):
+        self._client = client
+        self._clock = clock or SystemClock()
+        self._l = logger or default_logger("http")
+        self._watch_timeout = watch_timeout
+        self._latest: Result | None = None
+        self._next_round_event = asyncio.Event()
+        self._watch_task: asyncio.Task | None = None
+        self.app = web.Application(middlewares=[self._instrument])
+        self.app.add_routes([
+            web.get("/public/latest", self._handle_latest),
+            web.get("/public/{round}", self._handle_round),
+            web.get("/info", self._handle_info),
+            web.get("/health", self._handle_health),
+            web.get("/metrics", self._handle_metrics),
+        ])
+
+    # ------------------------------------------------------------ serving
+    async def start(self, host: str, port: int) -> web.TCPSite:
+        self._watch_task = asyncio.ensure_future(self._watch_loop())
+        runner = web.AppRunner(self.app)
+        await runner.setup()
+        site = web.TCPSite(runner, host, port)
+        await site.start()
+        self._runner = runner
+        return site
+
+    async def stop(self) -> None:
+        if self._watch_task is not None:
+            self._watch_task.cancel()
+        await self._runner.cleanup()
+
+    async def _watch_loop(self) -> None:
+        """Track the tip so /public/{next} can long-poll (server.go:102)."""
+        while True:
+            try:
+                async for r in self._client.watch():
+                    self._latest = r
+                    self._next_round_event.set()
+                    self._next_round_event = asyncio.Event()
+            except asyncio.CancelledError:
+                return
+            except Exception as e:  # noqa: BLE001 — keep serving
+                self._l.warn("http", "watch_restart", err=str(e))
+                await asyncio.sleep(1.0)
+
+    # ------------------------------------------------------------ handlers
+    @web.middleware
+    async def _instrument(self, request: web.Request, handler):
+        from .. import metrics
+
+        path = request.match_info.route.resource
+        path = path.canonical if path else request.path
+        with metrics.HTTP_LATENCY.labels(path=path).time():
+            resp = await handler(request)
+        metrics.HTTP_REQUESTS.labels(path=path, code=resp.status).inc()
+        return resp
+
+    async def _handle_metrics(self, request: web.Request) -> web.Response:
+        from .. import metrics
+
+        return web.Response(body=metrics.render(),
+                            content_type="text/plain")
+
+    async def _handle_latest(self, request: web.Request) -> web.Response:
+        try:
+            r = await self._client.get(0)
+        except ClientError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        return web.json_response(result_json(r))
+
+    async def _handle_round(self, request: web.Request) -> web.Response:
+        try:
+            round_no = int(request.match_info["round"])
+        except ValueError:
+            return web.json_response({"error": "bad round"}, status=400)
+        try:
+            return web.json_response(result_json(await self._client.get(round_no)))
+        except ClientError:
+            pass
+        # long-poll: if it's the upcoming round, wait for it (server.go:102)
+        info = await self._client.info()
+        expected = time_math.current_round(
+            int(self._clock.now()), info.period, info.genesis_time)
+        if round_no > expected + 1:
+            return web.json_response({"error": "round in the future"},
+                                     status=404)
+        event = self._next_round_event
+        try:
+            await asyncio.wait_for(event.wait(), self._watch_timeout)
+        except asyncio.TimeoutError:
+            return web.json_response({"error": "timeout waiting for round"},
+                                     status=404)
+        try:
+            return web.json_response(result_json(await self._client.get(round_no)))
+        except ClientError as e:
+            return web.json_response({"error": str(e)}, status=404)
+
+    async def _handle_info(self, request: web.Request) -> web.Response:
+        info = await self._client.info()
+        return web.json_response({
+            "public_key": info.public_key.to_bytes().hex(),
+            "period": info.period,
+            "genesis_time": info.genesis_time,
+            "group_hash": info.group_hash.hex(),
+            "hash": info.hash().hex(),
+        })
+
+    async def _handle_health(self, request: web.Request) -> web.Response:
+        """Current vs expected round (http/server.go:351)."""
+        info = await self._client.info()
+        expected = time_math.current_round(
+            int(self._clock.now()), info.period, info.genesis_time)
+        current = self._latest.round if self._latest is not None else 0
+        if current == 0:
+            try:
+                current = (await self._client.get(0)).round
+            except ClientError:
+                current = 0
+        body = {"current": current, "expected": expected}
+        status = 200 if current + 1 >= expected else 500
+        return web.json_response(body, status=status)

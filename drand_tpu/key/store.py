@@ -1,0 +1,170 @@
+"""On-disk persistence of key material: keypair, DKG share, group file.
+
+Reference: key/store.go (Store :16, NewFileStore :63, Save/Load :131-160)
+— TOML files under <base>/key and <base>/groups, 0700 directories and 0600
+files. File names match the reference (drand_id.{private,public},
+dist_key.private, drand_group.toml) so operators find familiar layouts.
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+
+from ..crypto.curves import PointG1
+from ..crypto.poly import PriShare
+from ..utils import fs
+from .group import Group
+from .keys import DistPublic, Identity, Pair, Share
+
+KEY_FOLDER = "key"
+GROUP_FOLDER = "groups"
+KEY_FILE = "drand_id"
+SHARE_FILE = "dist_key.private"
+GROUP_FILE = "drand_group.toml"
+DIST_KEY_FILE = "dist_key.public"
+
+
+class KeyStoreError(Exception):
+    pass
+
+
+def _toml_escape(s: str) -> str:
+    return s.replace("\\", "\\\\").replace('"', '\\"')
+
+
+def _emit(d: dict, out: list[str], table: str | None = None) -> None:
+    """Minimal TOML writer for the flat(+array-of-tables) shapes we store."""
+    scalars = {k: v for k, v in d.items() if not isinstance(v, (dict, list))
+               or (isinstance(v, list) and all(isinstance(x, str) for x in v))}
+    tables = {k: v for k, v in d.items() if k not in scalars}
+    if table:
+        out.append(f"[{table}]")
+    for k, v in scalars.items():
+        if isinstance(v, bool):
+            out.append(f"{k} = {'true' if v else 'false'}")
+        elif isinstance(v, int):
+            out.append(f"{k} = {v}")
+        elif isinstance(v, list):
+            items = ", ".join(f'"{_toml_escape(x)}"' for x in v)
+            out.append(f"{k} = [{items}]")
+        else:
+            out.append(f'{k} = "{_toml_escape(str(v))}"')
+    out.append("")
+    for k, v in tables.items():
+        if isinstance(v, list):  # array of tables
+            for entry in v:
+                out.append(f"[[{k}]]")
+                for ek, ev in entry.items():
+                    if isinstance(ev, bool):
+                        out.append(f"{ek} = {'true' if ev else 'false'}")
+                    elif isinstance(ev, int):
+                        out.append(f"{ek} = {ev}")
+                    else:
+                        out.append(f'{ek} = "{_toml_escape(str(ev))}"')
+                out.append("")
+        else:
+            _emit(v, out, table=k)
+
+
+def dump_toml(d: dict) -> str:
+    out: list[str] = []
+    _emit(d, out)
+    return "\n".join(out) + "\n"
+
+
+class FileStore:
+    """key.Store implementation over TOML files (key/store.go:63)."""
+
+    def __init__(self, base_folder: str):
+        self.base = base_folder
+        self.key_folder = fs.create_secure_folder(
+            os.path.join(base_folder, KEY_FOLDER))
+        self.group_folder = fs.create_secure_folder(
+            os.path.join(base_folder, GROUP_FOLDER))
+        self.private_key_file = os.path.join(self.key_folder, KEY_FILE + ".private")
+        self.public_key_file = os.path.join(self.key_folder, KEY_FILE + ".public")
+        self.share_file = os.path.join(self.group_folder, SHARE_FILE)
+        self.group_file = os.path.join(self.group_folder, GROUP_FILE)
+        self.dist_key_file = os.path.join(self.group_folder, DIST_KEY_FILE)
+
+    # ------------------------------------------------------------- keypair
+    def save_key_pair(self, pair: Pair) -> None:
+        priv = {
+            "Key": hex(pair.key)[2:].zfill(64),
+            "Public": pair.public.key.to_bytes().hex(),
+            "Address": pair.public.addr,
+            "TLS": pair.public.tls,
+            "Signature": pair.public.signature.hex(),
+        }
+        fs.write_secure_file(self.private_key_file,
+                             dump_toml(priv).encode())
+        pub = {
+            "Address": pair.public.addr,
+            "Key": pair.public.key.to_bytes().hex(),
+            "TLS": pair.public.tls,
+            "Signature": pair.public.signature.hex(),
+        }
+        fs.write_secure_file(self.public_key_file, dump_toml(pub).encode())
+
+    def load_key_pair(self) -> Pair:
+        d = self._read(self.private_key_file)
+        ident = Identity(
+            key=PointG1.from_bytes(bytes.fromhex(d["Public"])),
+            addr=d.get("Address", ""),
+            tls=bool(d.get("TLS", False)),
+            signature=bytes.fromhex(d.get("Signature", "")),
+        )
+        return Pair(key=int(d["Key"], 16), public=ident)
+
+    # --------------------------------------------------------------- share
+    def save_share(self, share: Share) -> None:
+        d = {
+            "Index": share.pri_share.index,
+            "Share": hex(share.pri_share.value)[2:].zfill(64),
+            "Commits": [c.to_bytes().hex() for c in share.commits],
+        }
+        fs.write_secure_file(self.share_file, dump_toml(d).encode())
+
+    def load_share(self) -> Share:
+        d = self._read(self.share_file)
+        return Share(
+            commits=[PointG1.from_bytes(bytes.fromhex(c))
+                     for c in d["Commits"]],
+            pri_share=PriShare(index=int(d["Index"]),
+                               value=int(d["Share"], 16)),
+        )
+
+    # --------------------------------------------------------------- group
+    def save_group(self, group: Group) -> None:
+        fs.write_secure_file(self.group_file,
+                             dump_toml(group.to_dict()).encode())
+        if group.public_key is not None:
+            d = {"Coefficients": [c.to_bytes().hex()
+                                  for c in group.public_key.coefficients]}
+            fs.write_secure_file(self.dist_key_file, dump_toml(d).encode())
+
+    def load_group(self) -> Group:
+        return Group.from_dict(self._read(self.group_file))
+
+    def load_dist_public(self) -> DistPublic:
+        d = self._read(self.dist_key_file)
+        return DistPublic([PointG1.from_bytes(bytes.fromhex(c))
+                           for c in d["Coefficients"]])
+
+    # ------------------------------------------------------------ plumbing
+    def has_key_pair(self) -> bool:
+        return fs.file_exists(self.private_key_file)
+
+    def has_share(self) -> bool:
+        return fs.file_exists(self.share_file)
+
+    def has_group(self) -> bool:
+        return fs.file_exists(self.group_file)
+
+    @staticmethod
+    def _read(path: str) -> dict:
+        if not fs.file_exists(path):
+            raise KeyStoreError(f"no such file: {path}")
+        with open(path, "rb") as f:
+            return tomllib.load(f)

@@ -1,0 +1,58 @@
+"""Prometheus metrics.
+
+Reference: metrics/metrics.go:17-146 — beacon discrepancy latency, last
+round gauges, dial failures, HTTP counters — and the store decorator that
+feeds them (chain/beacon/store.go:57 discrepancyStore). Exposed on the
+public REST server's /metrics route (the reference serves a dedicated
+metrics port; one port fewer here, same scrape surface).
+"""
+
+from __future__ import annotations
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+REGISTRY = CollectorRegistry()
+
+# chain/beacon metrics (metrics.go:41-50)
+BEACON_DISCREPANCY_LATENCY = Gauge(
+    "beacon_discrepancy_latency_ms",
+    "Milliseconds between the expected round time and the beacon being stored",
+    registry=REGISTRY)
+LAST_BEACON_ROUND = Gauge(
+    "last_beacon_round", "Last aggregated and stored beacon round",
+    registry=REGISTRY)
+
+# network health (metrics.go:60-75)
+DIAL_FAILURES = Counter(
+    "outgoing_connection_failures",
+    "Failed outbound node-to-node calls", ["peer"], registry=REGISTRY)
+DKG_BUNDLES = Counter(
+    "dkg_bundles_received", "DKG bundles accepted by the broadcast board",
+    ["kind"], registry=REGISTRY)
+
+# public API (metrics.go:90-120)
+HTTP_REQUESTS = Counter(
+    "http_api_requests", "Public REST API calls", ["path", "code"],
+    registry=REGISTRY)
+HTTP_LATENCY = Histogram(
+    "http_api_latency_seconds", "Public REST API latency", ["path"],
+    registry=REGISTRY)
+
+# crypto engine
+ENGINE_BATCHES = Counter(
+    "engine_device_batches", "Batched device crypto calls", ["op"],
+    registry=REGISTRY)
+ENGINE_FALLBACKS = Counter(
+    "engine_device_fallbacks", "Device-engine failures that fell back to host",
+    registry=REGISTRY)
+
+
+def render() -> bytes:
+    """The /metrics payload."""
+    return generate_latest(REGISTRY)

@@ -1,0 +1,219 @@
+"""Operator control plane: localhost gRPC port driving the daemon.
+
+Reference: net/control.go (ControlListener :17, ControlClient :48) and
+protobuf/drand/control.proto:14-37 (PingPong, InitDKG, InitReshare,
+PublicKey, ChainInfo, GroupFile, Shutdown, StartFollowChain). The CLI
+(`python -m drand_tpu.cli`) talks to a running daemon exclusively through
+this port, like `drand` does.
+
+Payloads are plain JSON (operator plane, localhost only — the node<->node
+plane uses wire.py envelopes).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import grpc
+import grpc.aio
+
+from ..utils.logging import KVLogger, default_logger
+
+SERVICE = "drand.Control"
+_METHODS = ("Ping", "InitDKG", "InitReshare", "PublicKey", "GroupFile",
+            "ChainInfo", "Status", "Shutdown", "Follow")
+
+
+class ControlServer:
+    def __init__(self, daemon, port: int, logger: KVLogger | None = None):
+        self._d = daemon
+        self._port = port
+        self._l = logger or default_logger("control")
+        self._server: grpc.aio.Server | None = None
+        self.port: int | None = None
+        self._shutdown_event = asyncio.Event()
+
+    async def start(self) -> None:
+        server = grpc.aio.server()
+        handlers = {
+            name: grpc.unary_unary_rpc_method_handler(self._dispatch(name))
+            for name in _METHODS
+        }
+        server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+        self.port = server.add_insecure_port(f"127.0.0.1:{self._port}")
+        if self.port == 0:
+            raise RuntimeError(f"cannot bind control port {self._port}")
+        await server.start()
+        self._server = server
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(0.2)
+
+    async def wait_shutdown(self) -> None:
+        await self._shutdown_event.wait()
+
+    def _dispatch(self, name: str):
+        method = getattr(self, f"_{name.lower()}")
+
+        async def handler(request: bytes, context) -> bytes:
+            try:
+                req = json.loads(request) if request else {}
+                resp = await method(req)
+                return json.dumps(resp).encode()
+            except Exception as e:  # noqa: BLE001 — operator plane
+                await context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                                    f"{type(e).__name__}: {e}")
+        return handler
+
+    # ------------------------------------------------------------ methods
+    async def _ping(self, req: dict) -> dict:
+        return {"pong": True}
+
+    async def _initdkg(self, req: dict) -> dict:
+        if req.get("leader"):
+            group = await self._d.init_dkg_leader(
+                expected_n=int(req["nodes"]), threshold=int(req["threshold"]),
+                period=int(req["period"]),
+                secret=bytes.fromhex(req["secret"]),
+                timeout=float(req.get("timeout", 60.0)),
+                catchup_period=int(req.get("catchup_period", 0)))
+        else:
+            group = await self._d.init_dkg_follower(
+                leader=req["connect"], secret=bytes.fromhex(req["secret"]),
+                timeout=float(req.get("timeout", 60.0)))
+        return {"group": group.to_dict()}
+
+    async def _initreshare(self, req: dict) -> dict:
+        if req.get("leader"):
+            group = await self._d.init_reshare_leader(
+                expected_n=int(req["nodes"]), threshold=int(req["threshold"]),
+                secret=bytes.fromhex(req["secret"]),
+                timeout=float(req.get("timeout", 60.0)))
+        else:
+            old_group = None
+            if req.get("old_group"):
+                from ..key.group import Group
+
+                old_group = Group.from_dict(req["old_group"])
+            group = await self._d.init_reshare_follower(
+                leader=req["connect"], secret=bytes.fromhex(req["secret"]),
+                old_group=old_group, leaving=bool(req.get("leaving", False)),
+                timeout=float(req.get("timeout", 60.0)))
+        return {"group": group.to_dict()}
+
+    async def _publickey(self, req: dict) -> dict:
+        return {"public_key": self._d.priv.public.key.to_bytes().hex()}
+
+    async def _groupfile(self, req: dict) -> dict:
+        if self._d.group is None:
+            raise RuntimeError("no group loaded")
+        return {"group": self._d.group.to_dict()}
+
+    async def _chaininfo(self, req: dict) -> dict:
+        info = await self._d.chain_info("control")
+        return json.loads(info.to_json())
+
+    async def _status(self, req: dict) -> dict:
+        last = 0
+        if self._d.beacon is not None:
+            try:
+                last = self._d.beacon.chain.last().round
+            except Exception:  # noqa: BLE001
+                last = 0
+        return {
+            "address": self._d.priv.public.addr,
+            "has_group": self._d.group is not None,
+            "has_share": self._d.share is not None,
+            "beacon_running": self._d.beacon is not None,
+            "last_round": last,
+        }
+
+    async def _shutdown(self, req: dict) -> dict:
+        self._d.stop()
+        self._shutdown_event.set()
+        return {"ok": True}
+
+    async def _follow(self, req: dict) -> dict:
+        """StartFollowChain analogue (core/drand_control.go:783): sync the
+        chain from peers without participating."""
+        up_to = int(req.get("up_to", 0))
+        peers = req.get("peers", [])
+        ok = await self._d.follow_chain(peers, up_to)
+        return {"ok": ok, "last": (await self._status({}))["last_round"]}
+
+
+class ControlClient:
+    """CLI side (net/control.go:48)."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1"):
+        self._target = f"{host}:{port}"
+        self._channel: grpc.aio.Channel | None = None
+
+    async def _call(self, method: str, req: dict, timeout: float = 120.0) -> dict:
+        if self._channel is None:
+            self._channel = grpc.aio.insecure_channel(self._target)
+        fn = self._channel.unary_unary(f"/{SERVICE}/{method}")
+        try:
+            raw = await fn(json.dumps(req).encode(), timeout=timeout)
+        except grpc.aio.AioRpcError as e:
+            raise RuntimeError(
+                f"control {method}: {e.code().name} {e.details()}") from e
+        return json.loads(raw)
+
+    async def close(self) -> None:
+        if self._channel is not None:
+            await self._channel.close()
+
+    async def ping(self) -> bool:
+        return (await self._call("Ping", {}, timeout=5.0)).get("pong", False)
+
+    async def init_dkg_leader(self, nodes: int, threshold: int, period: int,
+                              secret: bytes, timeout: float = 60.0,
+                              catchup_period: int = 0) -> dict:
+        return await self._call("InitDKG", {
+            "leader": True, "nodes": nodes, "threshold": threshold,
+            "period": period, "secret": secret.hex(), "timeout": timeout,
+            "catchup_period": catchup_period}, timeout=timeout + 120)
+
+    async def init_dkg_follower(self, connect: str, secret: bytes,
+                                timeout: float = 60.0) -> dict:
+        return await self._call("InitDKG", {
+            "leader": False, "connect": connect, "secret": secret.hex(),
+            "timeout": timeout}, timeout=timeout + 120)
+
+    async def init_reshare_leader(self, nodes: int, threshold: int,
+                                  secret: bytes, timeout: float = 60.0) -> dict:
+        return await self._call("InitReshare", {
+            "leader": True, "nodes": nodes, "threshold": threshold,
+            "secret": secret.hex(), "timeout": timeout}, timeout=timeout + 120)
+
+    async def init_reshare_follower(self, connect: str, secret: bytes,
+                                    old_group: dict | None = None,
+                                    leaving: bool = False,
+                                    timeout: float = 60.0) -> dict:
+        return await self._call("InitReshare", {
+            "leader": False, "connect": connect, "secret": secret.hex(),
+            "old_group": old_group, "leaving": leaving,
+            "timeout": timeout}, timeout=timeout + 120)
+
+    async def public_key(self) -> str:
+        return (await self._call("PublicKey", {}))["public_key"]
+
+    async def group_file(self) -> dict:
+        return (await self._call("GroupFile", {}))["group"]
+
+    async def chain_info(self) -> dict:
+        return await self._call("ChainInfo", {})
+
+    async def status(self) -> dict:
+        return await self._call("Status", {})
+
+    async def shutdown(self) -> dict:
+        return await self._call("Shutdown", {})
+
+    async def follow(self, peers: list[str], up_to: int = 0) -> dict:
+        return await self._call("Follow", {"peers": peers, "up_to": up_to},
+                                timeout=3600)

@@ -1,0 +1,201 @@
+"""gRPC transport: the across-hosts node<->node plane.
+
+Reference: net/gateway.go (PrivateGateway :17), net/listener.go
+(NewGRPCListenerForPrivate :27), net/client_grpc.go (grpcClient :27, pooled
+conns :271, per-call timeouts, streaming SyncChain :219).
+
+grpc.aio with generic method handlers (no codegen in this image); payloads
+are wire.py envelopes. Service surface mirrors protobuf/drand/
+protocol.proto:16-33: GetIdentity, SignalDKGParticipant, PushDKGInfo,
+BroadcastDKG, PartialBeacon (unary) and SyncChain (server-streaming).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator
+
+import grpc
+import grpc.aio
+
+from ..chain.beacon import Beacon
+from ..utils.logging import KVLogger, default_logger
+from . import wire
+from .packets import PartialBeaconPacket, SyncRequest
+from .transport import ProtocolClient, ProtocolService, TransportError
+
+SERVICE = "drand.Protocol"
+_UNARY = ("GetIdentity", "SignalDKGParticipant", "PushDKGInfo",
+          "BroadcastDKG", "PartialBeacon", "ChainInfo")
+
+DEFAULT_TIMEOUT = 5.0
+SYNC_TIMEOUT = 600.0
+
+
+class GrpcGateway:
+    """Server side: exposes a ProtocolService on a TCP port."""
+
+    def __init__(self, service: ProtocolService, listen: str,
+                 logger: KVLogger | None = None):
+        self._svc = service
+        self._listen = listen
+        self._l = logger or default_logger("grpc")
+        self._server: grpc.aio.Server | None = None
+        self.port: int | None = None
+
+    async def start(self) -> None:
+        server = grpc.aio.server()
+        handlers = {}
+        for name in _UNARY:
+            handlers[name] = grpc.unary_unary_rpc_method_handler(
+                self._unary(name))
+        handlers["SyncChain"] = grpc.unary_stream_rpc_method_handler(
+            self._sync_chain)
+        server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+        self.port = server.add_insecure_port(self._listen)
+        if self.port == 0:
+            raise TransportError(f"cannot bind {self._listen}")
+        await server.start()
+        self._server = server
+        self._l.info("grpc", "listening", addr=self._listen, port=self.port)
+
+    async def stop(self, grace: float = 0.5) -> None:
+        if self._server is not None:
+            await self._server.stop(grace)
+
+    # ------------------------------------------------------------ handlers
+    def _unary(self, name: str):
+        method = {
+            "GetIdentity": self._get_identity,
+            "SignalDKGParticipant": self._signal,
+            "PushDKGInfo": self._push_group,
+            "BroadcastDKG": self._broadcast,
+            "PartialBeacon": self._partial,
+            "ChainInfo": self._chain_info,
+        }[name]
+
+        async def handler(request: bytes, context) -> bytes:
+            try:
+                msg, from_addr = wire.decode(request)
+                return await method(msg, from_addr)
+            except wire.WireError as e:
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            except (TransportError, PermissionError, ValueError) as e:
+                await context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                                    str(e))
+        return handler
+
+    async def _get_identity(self, msg, from_addr) -> bytes:
+        ident = await self._svc.get_identity(from_addr)
+        return wire.encode(ident)
+
+    async def _signal(self, msg, from_addr) -> bytes:
+        await self._svc.signal_dkg_participant(from_addr, msg)
+        return b"{}"
+
+    async def _push_group(self, msg, from_addr) -> bytes:
+        await self._svc.push_dkg_info(from_addr, msg)
+        return b"{}"
+
+    async def _broadcast(self, msg, from_addr) -> bytes:
+        await self._svc.broadcast_dkg(from_addr, msg)
+        return b"{}"
+
+    async def _partial(self, msg, from_addr) -> bytes:
+        await self._svc.process_partial_beacon(from_addr, msg)
+        return b"{}"
+
+    async def _chain_info(self, msg, from_addr) -> bytes:
+        info = await self._svc.chain_info(from_addr)
+        return wire.encode(info)
+
+    async def _sync_chain(self, request: bytes, context):
+        try:
+            msg, from_addr = wire.decode(request)
+        except wire.WireError as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            return
+        try:
+            async for b in self._svc.sync_chain(from_addr, msg):
+                yield wire.encode(b)
+        except TransportError as e:
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+
+
+class GrpcClient(ProtocolClient):
+    """Outbound calls with a per-peer channel pool (client_grpc.go:271)."""
+
+    def __init__(self, own_addr: str, timeout: float = DEFAULT_TIMEOUT,
+                 logger: KVLogger | None = None):
+        self._addr = own_addr
+        self._timeout = timeout
+        self._l = logger or default_logger("grpc.client")
+        self._channels: dict[str, grpc.aio.Channel] = {}
+
+    def _channel(self, peer) -> tuple[grpc.aio.Channel, str]:
+        target = peer.address() if hasattr(peer, "address") else str(peer)
+        ch = self._channels.get(target)
+        if ch is None:
+            ch = grpc.aio.insecure_channel(target)
+            self._channels[target] = ch
+        return ch, target
+
+    async def close(self) -> None:
+        for ch in self._channels.values():
+            await ch.close()
+        self._channels.clear()
+
+    async def _call(self, peer, method: str, msg) -> bytes:
+        ch, target = self._channel(peer)
+        fn = ch.unary_unary(f"/{SERVICE}/{method}")
+        try:
+            return await fn(wire.encode(msg, from_addr=self._addr),
+                            timeout=self._timeout)
+        except grpc.aio.AioRpcError as e:
+            from .. import metrics
+
+            metrics.DIAL_FAILURES.labels(peer=target).inc()
+            raise TransportError(
+                f"{target} {method}: {e.code().name} {e.details()}") from e
+
+    # ------------------------------------------------------ ProtocolClient
+    async def partial_beacon(self, peer, packet: PartialBeaconPacket) -> None:
+        await self._call(peer, "PartialBeacon", packet)
+
+    async def sync_chain(self, peer, req: SyncRequest) -> AsyncIterator[Beacon]:
+        ch, target = self._channel(peer)
+        fn = ch.unary_stream(f"/{SERVICE}/SyncChain")
+        call = fn(wire.encode(req, from_addr=self._addr),
+                  timeout=SYNC_TIMEOUT)
+        try:
+            async for raw in call:
+                msg, _ = wire.decode(raw)
+                yield msg
+        except grpc.aio.AioRpcError as e:
+            raise TransportError(
+                f"{target} SyncChain: {e.code().name} {e.details()}") from e
+
+    async def broadcast_dkg(self, peer, packet) -> None:
+        await self._call(peer, "BroadcastDKG", packet)
+
+    async def signal_dkg_participant(self, peer, packet) -> None:
+        await self._call(peer, "SignalDKGParticipant", packet)
+
+    async def push_dkg_info(self, peer, packet) -> None:
+        await self._call(peer, "PushDKGInfo", packet)
+
+    async def chain_info(self, peer):
+        raw = await self._call(peer, "ChainInfo", b_empty())
+        msg, _ = wire.decode(raw)
+        return msg
+
+    async def get_identity(self, peer):
+        raw = await self._call(peer, "GetIdentity", b_empty())
+        msg, _ = wire.decode(raw)
+        return msg
+
+
+def b_empty():
+    """Placeholder request for argument-less RPCs."""
+    return SyncRequest(from_round=0)

@@ -8,8 +8,12 @@ the in-memory test transport passes them directly.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import TYPE_CHECKING
 
 from ..chain.beacon import Beacon
+
+if TYPE_CHECKING:
+    from ..key.keys import Identity
 
 
 @dataclass(frozen=True)
@@ -23,6 +27,27 @@ class PartialBeaconPacket:
 @dataclass(frozen=True)
 class SyncRequest:
     from_round: int
+
+
+@dataclass(frozen=True)
+class SignalDKGPacket:
+    """SignalDKGParticipant payload (protocol.proto PeerIdentity + secret):
+    a participant announces itself to the setup leader."""
+
+    identity: "Identity"
+    secret: bytes
+    previous_group_hash: bytes = b""  # reshare: pins the old group epoch
+
+
+@dataclass(frozen=True)
+class GroupPacket:
+    """PushDKGInfo payload (common.proto GroupPacket + leader signature):
+    the leader-signed group file plus the session secret."""
+
+    group: dict           # Group.to_dict()
+    signature: bytes      # leader schnorr over the group hash
+    secret: bytes
+    dkg_timeout: float = 10.0
 
 
 def beacon_to_packet(b: Beacon) -> dict:

@@ -1,0 +1,188 @@
+"""Byte-level wire codec for node<->node and control messages.
+
+The reference serializes with protobuf (protobuf/drand/*.proto); this
+framework owns both endpoints, so it uses a deterministic JSON envelope
+with hex-encoded byte fields — the public REST API (http_server/) remains
+the cross-ecosystem interop surface. Every message is
+``{"t": <type>, "from": <sender listen addr>, "m": {...}}``; unknown types
+or malformed fields raise WireError (ingress is untrusted).
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..chain.beacon import Beacon
+from ..chain.info import Info
+from ..crypto.curves import PointG1
+from ..dkg.packets import (
+    Deal,
+    DealBundle,
+    Justification,
+    JustificationBundle,
+    Response,
+    ResponseBundle,
+)
+from ..key.keys import Identity
+from .packets import GroupPacket, PartialBeaconPacket, SignalDKGPacket, SyncRequest
+
+
+class WireError(Exception):
+    pass
+
+
+def _hex(b: bytes) -> str:
+    return b.hex()
+
+
+def _unhex(s: str) -> bytes:
+    return bytes.fromhex(s)
+
+
+# --------------------------------------------------------------- encoders
+
+def _enc_identity(i: Identity) -> dict:
+    return {"key": _hex(i.key.to_bytes()), "addr": i.addr, "tls": i.tls,
+            "sig": _hex(i.signature)}
+
+
+def _dec_identity(d: dict) -> Identity:
+    return Identity(key=PointG1.from_bytes(_unhex(d["key"])),
+                    addr=d["addr"], tls=bool(d.get("tls", False)),
+                    signature=_unhex(d.get("sig", "")))
+
+
+_ENC = {}
+_DEC = {}
+
+
+def _codec(name):
+    def wrap(cls_enc_dec):
+        enc, dec = cls_enc_dec
+        _ENC[name] = enc
+        _DEC[name] = dec
+        return cls_enc_dec
+    return wrap
+
+
+_codec("partial_beacon")((
+    lambda p: {"round": p.round, "prev": _hex(p.previous_sig),
+               "sig": _hex(p.partial_sig), "sig_v2": _hex(p.partial_sig_v2)},
+    lambda d: PartialBeaconPacket(
+        round=int(d["round"]), previous_sig=_unhex(d["prev"]),
+        partial_sig=_unhex(d["sig"]), partial_sig_v2=_unhex(d["sig_v2"]))))
+
+_codec("sync_request")((
+    lambda r: {"from_round": r.from_round},
+    lambda d: SyncRequest(from_round=int(d["from_round"]))))
+
+_codec("beacon")((
+    lambda b: {"round": b.round, "prev": _hex(b.previous_sig),
+               "sig": _hex(b.signature), "sig_v2": _hex(b.signature_v2)},
+    lambda d: Beacon(round=int(d["round"]), previous_sig=_unhex(d["prev"]),
+                     signature=_unhex(d["sig"]),
+                     signature_v2=_unhex(d.get("sig_v2", "")))))
+
+_codec("info")((
+    lambda i: {"public_key": _hex(i.public_key.to_bytes()),
+               "period": i.period, "genesis_time": i.genesis_time,
+               "genesis_seed": _hex(i.genesis_seed),
+               "group_hash": _hex(i.group_hash)},
+    lambda d: Info(public_key=PointG1.from_bytes(_unhex(d["public_key"])),
+                   period=int(d["period"]),
+                   genesis_time=int(d["genesis_time"]),
+                   genesis_seed=_unhex(d["genesis_seed"]),
+                   group_hash=_unhex(d.get("group_hash", "")))))
+
+_codec("identity")((_enc_identity, _dec_identity))
+
+_codec("signal_dkg")((
+    lambda p: {"identity": _enc_identity(p.identity),
+               "secret": _hex(p.secret),
+               "prev_group": _hex(p.previous_group_hash)},
+    lambda d: SignalDKGPacket(identity=_dec_identity(d["identity"]),
+                              secret=_unhex(d["secret"]),
+                              previous_group_hash=_unhex(
+                                  d.get("prev_group", "")))))
+
+_codec("group_packet")((
+    lambda p: {"group": p.group, "sig": _hex(p.signature),
+               "secret": _hex(p.secret), "dkg_timeout": p.dkg_timeout},
+    lambda d: GroupPacket(group=d["group"], signature=_unhex(d["sig"]),
+                          secret=_unhex(d["secret"]),
+                          dkg_timeout=float(d.get("dkg_timeout", 10.0)))))
+
+_codec("deal_bundle")((
+    lambda b: {"dealer": b.dealer_index,
+               "commits": [_hex(c) for c in b.commits],
+               "deals": [{"i": dl.share_index,
+                          "enc": _hex(dl.encrypted_share)}
+                         for dl in b.deals],
+               "session": _hex(b.session_id), "sig": _hex(b.signature)},
+    lambda d: DealBundle(
+        dealer_index=int(d["dealer"]),
+        commits=tuple(_unhex(c) for c in d["commits"]),
+        deals=tuple(Deal(share_index=int(x["i"]),
+                         encrypted_share=_unhex(x["enc"]))
+                    for x in d["deals"]),
+        session_id=_unhex(d["session"]), signature=_unhex(d["sig"]))))
+
+_codec("response_bundle")((
+    lambda b: {"share": b.share_index,
+               "responses": [{"d": r.dealer_index, "s": r.status}
+                             for r in b.responses],
+               "session": _hex(b.session_id), "sig": _hex(b.signature)},
+    lambda d: ResponseBundle(
+        share_index=int(d["share"]),
+        responses=tuple(Response(dealer_index=int(x["d"]),
+                                 status=int(x["s"]))
+                        for x in d["responses"]),
+        session_id=_unhex(d["session"]), signature=_unhex(d["sig"]))))
+
+_codec("justification_bundle")((
+    lambda b: {"dealer": b.dealer_index,
+               "justs": [{"i": j.share_index, "v": hex(j.share)}
+                         for j in b.justifications],
+               "session": _hex(b.session_id), "sig": _hex(b.signature)},
+    lambda d: JustificationBundle(
+        dealer_index=int(d["dealer"]),
+        justifications=tuple(Justification(share_index=int(x["i"]),
+                                           share=int(x["v"], 16))
+                             for x in d["justs"]),
+        session_id=_unhex(d["session"]), signature=_unhex(d["sig"]))))
+
+_TYPE_OF = {
+    PartialBeaconPacket: "partial_beacon",
+    SyncRequest: "sync_request",
+    Beacon: "beacon",
+    Info: "info",
+    Identity: "identity",
+    SignalDKGPacket: "signal_dkg",
+    GroupPacket: "group_packet",
+    DealBundle: "deal_bundle",
+    ResponseBundle: "response_bundle",
+    JustificationBundle: "justification_bundle",
+}
+
+
+def encode(obj, from_addr: str = "") -> bytes:
+    t = _TYPE_OF.get(type(obj))
+    if t is None:
+        raise WireError(f"unencodable type {type(obj).__name__}")
+    return json.dumps({"t": t, "from": from_addr, "m": _ENC[t](obj)},
+                      separators=(",", ":")).encode()
+
+
+def decode(data: bytes) -> tuple[object, str]:
+    """-> (message, sender listen address). Raises WireError on garbage."""
+    try:
+        env = json.loads(data)
+        t = env["t"]
+        dec = _DEC.get(t)
+        if dec is None:
+            raise WireError(f"unknown message type {t!r}")
+        return dec(env["m"]), str(env.get("from", ""))
+    except WireError:
+        raise
+    except Exception as e:  # noqa: BLE001 — malformed input
+        raise WireError(f"malformed message: {e!r}") from e

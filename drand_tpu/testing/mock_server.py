@@ -1,0 +1,124 @@
+"""Mock beacon source with cryptographically REAL signatures.
+
+Reference: test/mock/grpcserver.go:184-238 — a fake public server whose
+chain is a real 1-of-2 threshold-BLS chain, with deliberate corruption
+switches for negative tests, plus stream control (EmitRand :97).
+Implements the client.Client surface and the sync_chain service so both
+the client stack and the syncer can be tested against it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..chain import time_math
+from ..chain.beacon import Beacon, message, message_v2
+from ..chain.info import Info
+from ..client.interface import Client, ClientError, result_from_beacon
+from ..crypto import tbls
+from ..crypto.poly import PriPoly
+from ..net.transport import TransportError
+
+
+class MockBeaconServer(Client):
+    """Pre-generates `nrounds` of a real 1-of-2 tbls chain.
+
+    Switches:
+    - ``bad_second_round``: corrupt round 2's signature (grpcserver.go:184
+      generateMockData's deliberate corruption)
+    - ``bad_round(r, field)``: corrupt any round/field after the fact
+    """
+
+    def __init__(self, nrounds: int = 10, period: int = 30,
+                 genesis_time: int = 1_700_000_000,
+                 bad_second_round: bool = False,
+                 seed: bytes = b"mock-server"):
+        poly = PriPoly.random(2, seed=seed)
+        self._pub = poly.commit()
+        shares = poly.shares(2)
+        self._shares = shares
+        self.info = Info(
+            public_key=self._pub.commit(),
+            period=period,
+            genesis_time=genesis_time,
+            genesis_seed=b"\x77" * 32,
+            group_hash=b"\x77" * 32,
+        )
+        self.beacons: dict[int, Beacon] = {}
+        prev = self.info.genesis_seed
+        for rnd in range(1, nrounds + 1):
+            msg = message(rnd, prev)
+            partials = [tbls.sign_partial(s, msg) for s in shares]
+            sig = tbls.recover(self._pub, msg, partials, 2, 2)
+            partials_v2 = [tbls.sign_partial(s, message_v2(rnd))
+                           for s in shares]
+            sig_v2 = tbls.recover(self._pub, message_v2(rnd), partials_v2, 2, 2)
+            self.beacons[rnd] = Beacon(round=rnd, previous_sig=prev,
+                                       signature=sig, signature_v2=sig_v2)
+            prev = sig
+        self._tip = nrounds
+        if bad_second_round and 2 in self.beacons:
+            self.bad_round(2)
+        self._watchers: list[asyncio.Queue] = []
+
+    # -------------------------------------------------------- corruption
+    def bad_round(self, rnd: int, field: str = "signature") -> None:
+        b = self.beacons[rnd]
+        data = getattr(b, field)
+        setattr(b, field, bytes([data[0] ^ 1]) + data[1:])
+
+    # ------------------------------------------------------------ control
+    def emit(self, b: Beacon | None = None) -> Beacon:
+        """Append (or inject) the next beacon and wake watchers
+        (grpcserver.go:97 EmitRand)."""
+        if b is None:
+            rnd = self._tip + 1
+            prev = self.beacons[self._tip].signature
+            msg = message(rnd, prev)
+            poly_sig = self._resign(msg)
+            sig_v2 = self._resign(message_v2(rnd))
+            b = Beacon(round=rnd, previous_sig=prev, signature=poly_sig,
+                       signature_v2=sig_v2)
+        self.beacons[b.round] = b
+        self._tip = max(self._tip, b.round)
+        for q in list(self._watchers):
+            q.put_nowait(b)
+        return b
+
+    def _resign(self, msg: bytes) -> bytes:
+        partials = [tbls.sign_partial(s, msg) for s in self._shares]
+        return tbls.recover(self._pub, msg, partials, 2, 2)
+
+    # ------------------------------------------------------------- Client
+    async def get(self, round_no: int = 0):
+        rnd = round_no or self._tip
+        b = self.beacons.get(rnd)
+        if b is None:
+            raise ClientError(f"mock: no round {rnd}")
+        return result_from_beacon(b)
+
+    async def watch(self):
+        q: asyncio.Queue = asyncio.Queue()
+        self._watchers.append(q)
+        try:
+            while True:
+                yield result_from_beacon(await q.get())
+        finally:
+            self._watchers.remove(q)
+
+    async def info_(self) -> Info:
+        return self.info
+
+    async def info(self) -> Info:  # Client surface
+        return self.info
+
+    def round_at(self, t: float) -> int:
+        return time_math.current_round(int(t), self.info.period,
+                                       self.info.genesis_time)
+
+    # -------------------------------------------- sync service (server side)
+    async def sync_chain(self, from_addr: str, req):
+        if req.from_round > self._tip:
+            raise TransportError("mock: nothing to sync")
+        for rnd in range(max(1, req.from_round), self._tip + 1):
+            yield self.beacons[rnd]

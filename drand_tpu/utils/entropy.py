@@ -1,0 +1,28 @@
+"""Entropy sourcing for key generation and DKG secrets.
+
+Reference: entropy/entropy.go — OS randomness by default, with an optional
+user-supplied executable whose stdout is mixed in (never trusted alone:
+user entropy is XORed with crypto/rand so a bad script cannot weaken the
+result below the OS baseline; GetRandom :15, ScriptReader :39).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+
+def get_random(n: int, script: str | None = None) -> bytes:
+    """n random bytes; with `script`, its output is XOR-mixed in."""
+    base = os.urandom(n)
+    if not script:
+        return base
+    try:
+        out = subprocess.run(
+            [script], capture_output=True, timeout=10, check=True
+        ).stdout
+    except (OSError, subprocess.SubprocessError):
+        return base
+    if len(out) < n:
+        return base
+    return bytes(a ^ b for a, b in zip(base, out[:n]))

@@ -1,0 +1,46 @@
+"""Secure filesystem helpers.
+
+Reference: fs/fs.go — key material lives in 0700 directories and 0600
+files (CreateSecureFolder :26, CreateSecureFile :62); anything looser is
+rejected at load time.
+"""
+
+from __future__ import annotations
+
+import os
+import stat
+
+
+def create_secure_folder(path: str) -> str:
+    """mkdir -p with 0700; raises if it exists with looser permissions."""
+    if os.path.isdir(path):
+        mode = stat.S_IMODE(os.stat(path).st_mode)
+        if mode & 0o077:
+            raise PermissionError(
+                f"{path} has permissions {oct(mode)}; expected 0700")
+        return path
+    os.makedirs(path, mode=0o700, exist_ok=True)
+    os.chmod(path, 0o700)
+    return path
+
+
+def create_secure_file(path: str) -> str:
+    """Create (or truncate) a 0600 file."""
+    fd = os.open(path, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o600)
+    os.close(fd)
+    os.chmod(path, 0o600)
+    return path
+
+
+def write_secure_file(path: str, data: bytes) -> None:
+    create_secure_file(path)
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def home_folder() -> str:
+    return os.path.expanduser("~")
+
+
+def file_exists(path: str) -> bool:
+    return os.path.isfile(path)

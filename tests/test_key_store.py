@@ -1,0 +1,79 @@
+"""Key/group file persistence (reference key/store.go) and fs perms."""
+
+import os
+import stat
+
+import pytest
+
+from drand_tpu.key.group import Group
+from drand_tpu.key.keys import Node, new_key_pair
+from drand_tpu.key.store import FileStore, KeyStoreError
+from drand_tpu.testing.harness import synthesize_shares
+from drand_tpu.utils import entropy, fs
+
+
+def test_keypair_roundtrip(tmp_path):
+    store = FileStore(str(tmp_path / "drand"))
+    pair = new_key_pair("node-a.test:8080", seed=b"store-test")
+    store.save_key_pair(pair)
+    loaded = store.load_key_pair()
+    assert loaded.key == pair.key
+    assert loaded.public.equal(pair.public)
+    assert loaded.public.valid_signature()
+    # key files are 0600 inside 0700 folders
+    mode = stat.S_IMODE(os.stat(store.private_key_file).st_mode)
+    assert mode == 0o600
+    kmode = stat.S_IMODE(os.stat(store.key_folder).st_mode)
+    assert kmode == 0o700
+
+
+def test_share_roundtrip(tmp_path):
+    store = FileStore(str(tmp_path / "drand"))
+    shares, _ = synthesize_shares(3, 2, seed=b"share-store")
+    store.save_share(shares[1])
+    loaded = store.load_share()
+    assert loaded.pri_share == shares[1].pri_share
+    assert loaded.commits == shares[1].commits
+
+
+def test_group_roundtrip(tmp_path):
+    store = FileStore(str(tmp_path / "drand"))
+    pairs = [new_key_pair(f"n{i}.test:90{i:02d}", seed=b"grp%d" % i)
+             for i in range(4)]
+    shares, dist = synthesize_shares(4, 3, seed=b"group-store")
+    group = Group(
+        nodes=[Node(identity=p.public, index=i) for i, p in enumerate(pairs)],
+        threshold=3, period=30, genesis_time=1_700_000_100,
+        public_key=dist,
+    )
+    group.get_genesis_seed()
+    store.save_group(group)
+    loaded = store.load_group()
+    assert loaded.hash() == group.hash()
+    assert loaded.genesis_seed == group.genesis_seed
+    assert loaded.public_key.equal(group.public_key)
+    assert store.load_dist_public().equal(dist)
+
+
+def test_missing_files_raise(tmp_path):
+    store = FileStore(str(tmp_path / "drand"))
+    assert not store.has_key_pair() and not store.has_share()
+    with pytest.raises(KeyStoreError):
+        store.load_key_pair()
+
+
+def test_secure_folder_rejects_loose_perms(tmp_path):
+    loose = tmp_path / "loose"
+    loose.mkdir()
+    os.chmod(loose, 0o755)
+    with pytest.raises(PermissionError):
+        fs.create_secure_folder(str(loose))
+
+
+def test_entropy_mixing():
+    a = entropy.get_random(32)
+    b = entropy.get_random(32)
+    assert a != b and len(a) == 32
+    # script output is mixed, not used raw
+    mixed = entropy.get_random(16, script="/bin/pwd")
+    assert len(mixed) == 16

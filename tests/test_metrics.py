@@ -1,0 +1,44 @@
+"""Metrics: discrepancy store decorator + /metrics scrape surface."""
+
+import aiohttp
+import pytest
+
+from drand_tpu import metrics
+from drand_tpu.client.direct import DirectClient
+from drand_tpu.http_server.server import PublicServer
+from drand_tpu.testing.harness import BeaconTestNetwork
+
+N, T, PERIOD = 3, 2, 5
+
+
+@pytest.mark.asyncio
+async def test_discrepancy_and_scrape():
+    net = BeaconTestNetwork(n=N, t=T, period=PERIOD)
+    await net.start_all()
+    await net.advance_to_genesis()
+    for _ in range(2):
+        await net.clock.advance(PERIOD)
+    for i in range(N):
+        await net.wait_round(i, 2)
+    try:
+        # the discrepancy store fed the gauges while rounds were produced
+        assert metrics.LAST_BEACON_ROUND._value.get() >= 2
+        # fake clock: beacons land "instantly" at the round boundary
+        assert abs(metrics.BEACON_DISCREPANCY_LATENCY._value.get()) < 10_000
+
+        server = PublicServer(DirectClient(net.nodes[0].handler),
+                              clock=net.clock)
+        site = await server.start("127.0.0.1", 0)
+        port = site._server.sockets[0].getsockname()[1]
+        async with aiohttp.ClientSession() as sess:
+            async with sess.get(f"http://127.0.0.1:{port}/public/1") as r:
+                assert r.status == 200
+            async with sess.get(f"http://127.0.0.1:{port}/metrics") as r:
+                assert r.status == 200
+                body = await r.text()
+        assert "last_beacon_round" in body
+        assert "beacon_discrepancy_latency_ms" in body
+        assert "http_api_requests" in body
+        await server.stop()
+    finally:
+        net.stop_all()
