@@ -209,6 +209,15 @@ class SQLiteStore(Store):
             self._conn.execute("DELETE FROM beacons WHERE round = ?", (round_no,))
             self._conn.commit()
 
+    def del_from(self, round_no: int) -> int:
+        """Rollback: remove every round >= round_no in ONE transaction
+        (`drand util del-beacon` on a long chain must not fsync per round)."""
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM beacons WHERE round >= ?", (round_no,))
+            self._conn.commit()
+            return cur.rowcount
+
     def close(self) -> None:
         with self._lock:
             self._conn.close()

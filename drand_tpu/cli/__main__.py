@@ -52,7 +52,9 @@ def cmd_generate_keypair(args) -> None:
     if store.has_key_pair() and not args.force:
         raise SystemExit(f"keypair already exists in {store.key_folder} "
                          f"(--force to overwrite)")
-    pair = new_key_pair(args.address, tls=not args.tls_disable)
+    # tls=False until the secure transport lands; the identity flag must
+    # match what the gateway actually serves
+    pair = new_key_pair(args.address, tls=False)
     store.save_key_pair(pair)
     print(json.dumps({
         "address": args.address,
@@ -81,8 +83,6 @@ async def _run_daemon(args) -> None:
     conf = Config(folder=folder, control_port=args.control,
                   db_path=os.path.join(folder, "db", "chain.db"),
                   dkg_timeout=args.dkg_timeout)
-    priv_addr = None
-    client = None
     d = Drand.load(ks, conf, None, logger)
     priv_addr = args.private_listen or d.priv.public.addr
     client = GrpcClient(own_addr=d.priv.public.addr)
@@ -138,7 +138,19 @@ def cmd_share(args) -> None:
                 else:
                     old_group = None
                     if args.from_group:
-                        old_group = json.load(open(args.from_group))
+                        # the daemon writes TOML group files; accept JSON too
+                        import tomllib
+
+                        raw = open(args.from_group, "rb").read()
+                        try:
+                            old_group = tomllib.loads(raw.decode())
+                        except (tomllib.TOMLDecodeError, UnicodeDecodeError):
+                            try:
+                                old_group = json.loads(raw)
+                            except json.JSONDecodeError:
+                                raise SystemExit(
+                                    f"{args.from_group}: neither TOML nor "
+                                    f"JSON group file")
                     out = await ctl.init_reshare_follower(
                         args.connect, secret, old_group=old_group,
                         leaving=args.leaving, timeout=args.timeout)
@@ -234,6 +246,34 @@ def cmd_get(args) -> None:
 
 
 def cmd_util(args) -> None:
+    if args.what == "del-beacon":
+        # offline rollback (reference cli.go:651 deleteBeaconCmd): daemon
+        # must be stopped; removes every round >= --round
+        from ..chain.store import SQLiteStore, StoreError
+
+        db = os.path.join(_folder(args), "db", "chain.db")
+        if not os.path.isfile(db):
+            raise SystemExit(f"no chain db at {db}")
+        store = SQLiteStore(db)
+        try:
+            last = store.last().round
+        except StoreError:
+            raise SystemExit("chain db is empty")
+        removed = store.del_from(args.round)
+        store.close()
+        print(json.dumps({"deleted": removed, "from_round": args.round,
+                          "was_at": last}))
+        return
+    if args.what == "self-sign":
+        from ..key.store import FileStore
+
+        ks = FileStore(_folder(args))
+        pair = ks.load_key_pair()
+        pair.self_sign()
+        ks.save_key_pair(pair)
+        print(json.dumps({"address": pair.public.addr, "self_signed": True}))
+        return
+
     async def run():
         if args.what == "ping":
             from ..net.control import ControlClient
@@ -259,6 +299,28 @@ def cmd_util(args) -> None:
     asyncio.run(run())
 
 
+def cmd_relay(args) -> None:
+    """HTTP CDN relay (reference cmd/relay): serve the public API backed by
+    the VERIFIED client stack over one or more origin nodes."""
+
+    async def run():
+        from ..client import new_client
+        from ..client.http import HTTPClient
+        from ..http_server.server import PublicServer
+
+        sources = [HTTPClient(u) for u in args.url.split(",")]
+        chain_hash = bytes.fromhex(args.chain_hash) if args.chain_hash else b""
+        client = new_client(sources, chain_hash=chain_hash,
+                            insecurely=not chain_hash)
+        server = PublicServer(client)
+        host, port = args.listen.rsplit(":", 1)
+        await server.start(host or "0.0.0.0", int(port))
+        print(f"relay serving {args.listen} from {args.url}", flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="drand-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -266,7 +328,6 @@ def main(argv=None) -> None:
     g = sub.add_parser("generate-keypair")
     g.add_argument("address")
     g.add_argument("--folder")
-    g.add_argument("--tls-disable", action="store_true", default=True)
     g.add_argument("--force", action="store_true")
     g.set_defaults(fn=cmd_generate_keypair)
 
@@ -316,10 +377,21 @@ def main(argv=None) -> None:
     get.set_defaults(fn=cmd_get)
 
     u = sub.add_parser("util")
-    u.add_argument("what", choices=["ping", "check"])
+    u.add_argument("what", choices=["ping", "check", "del-beacon",
+                                    "self-sign"])
     u.add_argument("--control", type=int, default=8888)
     u.add_argument("--address")
+    u.add_argument("--folder")
+    u.add_argument("--round", type=int, default=1)
     u.set_defaults(fn=cmd_util)
+
+    r = sub.add_parser("relay")
+    r.add_argument("--url", required=True,
+                   help="comma-separated origin base URLs")
+    r.add_argument("--listen", required=True)
+    r.add_argument("--chain-hash", default="",
+                   help="hex chain hash to pin (verifies all beacons)")
+    r.set_defaults(fn=cmd_relay)
 
     args = p.parse_args(argv)
     args.fn(args)

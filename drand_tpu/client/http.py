@@ -55,7 +55,9 @@ class HTTPClient(Client):
                     raise ClientError(
                         f"GET {path}: {resp.status} {body.get('error', '')}")
                 return body
-        except aiohttp.ClientError as e:
+        except (aiohttp.ClientError, ValueError) as e:
+            # ValueError covers json.JSONDecodeError from malformed bodies:
+            # a ClientError keeps the optimizing client's failover working
             raise ClientError(f"GET {path}: {e!r}") from e
 
     # ------------------------------------------------------------- Client

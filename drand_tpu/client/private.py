@@ -1,0 +1,27 @@
+"""Private randomness: ECIES round-trip with a node.
+
+Reference: core/drand_public.go:126 PrivateRand and client usage — the
+caller sends an ephemeral public key encrypted to the node's longterm
+identity key; the node answers with 32 fresh bytes encrypted to the
+ephemeral key. Neither side learns anything from transit observation.
+"""
+
+from __future__ import annotations
+
+from ..crypto import bls, ecies
+from ..key.keys import Identity
+from .interface import ClientError
+
+
+async def private_rand(client, node_identity: Identity) -> bytes:
+    """Fetch 32 private random bytes from the node over the transport."""
+    eph_sk, eph_pub = bls.keygen()
+    request = ecies.encrypt(node_identity.key, eph_pub.to_bytes())
+    reply = await client.private_rand(node_identity, request)
+    try:
+        out = ecies.decrypt(eph_sk, reply)
+    except Exception as e:  # noqa: BLE001
+        raise ClientError(f"private rand: bad reply: {e!r}") from e
+    if len(out) != 32:
+        raise ClientError(f"private rand: expected 32 bytes, got {len(out)}")
+    return out

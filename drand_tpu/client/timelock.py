@@ -1,0 +1,53 @@
+"""Timelock ("encrypt to the future") helpers over the beacon chain.
+
+The fork-specific headline feature (SURVEY.md: core/timelock_test.go:17-72):
+the unchained V2 signature over H(round) acts as an IBE private key for
+identity = MessageV2(round), so anyone can encrypt a message that becomes
+decryptable exactly when the network publishes that round.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+
+from ..chain.beacon import message_v2
+from ..chain.info import Info
+from ..crypto import timelock
+from .interface import ClientError, Result
+
+
+def encrypt_to_round(info: Info, round_no: int, plaintext: bytes) -> dict:
+    """Encrypt so that the round's V2 signature decrypts
+    (kyber/encrypt/timelock analogue, core/timelock_test.go:43-48)."""
+    ct = timelock.encrypt(info.public_key, message_v2(round_no), plaintext)
+    return {
+        "round": round_no,
+        "chain_hash": info.hash().hex(),
+        "U": ct.u.hex(),
+        "V": base64.b64encode(ct.v).decode(),
+        "W": base64.b64encode(ct.w).decode(),
+    }
+
+
+def decrypt_with_beacon(ct: dict, result: Result) -> bytes:
+    """Decrypt once the round is out, using its unchained V2 signature."""
+    if result.round != ct["round"]:
+        raise ClientError(
+            f"need round {ct['round']}, got {result.round}")
+    if not result.signature_v2:
+        raise ClientError("beacon carries no V2 signature (pre-V2 era)")
+    parsed = timelock.Ciphertext(
+        u=bytes.fromhex(ct["U"]),
+        v=base64.b64decode(ct["V"]),
+        w=base64.b64decode(ct["W"]),
+    )
+    return timelock.decrypt(result.signature_v2, parsed)
+
+
+def dumps(ct: dict) -> str:
+    return json.dumps(ct, sort_keys=True)
+
+
+def loads(data: str | bytes) -> dict:
+    return json.loads(data)

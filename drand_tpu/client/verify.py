@@ -133,10 +133,12 @@ class VerifyingClient(Client):
                     raise ClientError(
                         f"round {bad.round}: invalid signature in history")
                 trust_round, trust_sig = beacons[-1].round, beacons[-1].signature
-            # never REGRESS the trust point: re-reading an old round must
-            # not throw away already-verified history
-            if self._trust is None or trust_round > self._trust[0]:
-                self._trust = (trust_round, trust_sig)
+                # persist trust PER CHUNK (never regressing): if the walk is
+                # cancelled mid-way (the optimizing client's per-request
+                # timeout wraps the whole get), the next attempt resumes
+                # from the last verified chunk instead of genesis
+                if self._trust is None or trust_round > self._trust[0]:
+                    self._trust = (trust_round, trust_sig)
             return trust_sig
 
     async def _fetch_span(self, lo: int, hi: int) -> list[Beacon]:

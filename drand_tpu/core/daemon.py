@@ -257,7 +257,7 @@ class Drand(ProtocolService):
             try:
                 await self.client.push_dkg_info(node.identity, packet)
                 oks += 1
-            except (TransportError, Exception) as e:  # noqa: BLE001
+            except TransportError as e:
                 self._l.warn("push_group", "failed", to=node.address(),
                              err=str(e))
         if oks + 1 < group.threshold:
@@ -391,6 +391,21 @@ class Drand(ProtocolService):
 
     async def get_identity(self, from_addr: str):
         return self.priv.public
+
+    async def private_rand(self, from_addr: str, request: bytes) -> bytes:
+        """ECIES private randomness (core/drand_public.go:126-160): decrypt
+        the requester's ephemeral key with our longterm key, return 32
+        fresh bytes encrypted to it."""
+        from ..crypto import ecies
+        from ..crypto.curves import PointG1
+        from ..utils import entropy
+
+        try:
+            client_key = PointG1.from_bytes(
+                ecies.decrypt(self.priv.key, bytes(request)))
+        except Exception as e:  # noqa: BLE001 — untrusted ingress
+            raise TransportError(f"private rand: bad request: {e!r}") from e
+        return ecies.encrypt(client_key, entropy.get_random(32))
 
     async def signal_dkg_participant(self, from_addr: str,
                                      packet: SignalDKGPacket) -> None:

@@ -1,0 +1,7 @@
+"""Demo orchestrator: spawn a real local network and exercise it.
+
+Reference: demo/lib/orchestrator.go:61 — spawns N daemon processes, runs
+the DKG, checks beacons every period by querying every node and
+independently re-verifying the signature chain (incl. over plain HTTP),
+kills/restarts nodes, and runs a resharing. `python -m drand_tpu.demo`.
+"""

@@ -1,0 +1,225 @@
+"""Run the demo orchestration: a real local drand-tpu network.
+
+    python -m drand_tpu.demo --nodes 4 --threshold 3 --period 3 \
+        [--rounds 5] [--kill-one] [--workdir DIR]
+
+Spawns N daemons (subprocesses, real gRPC), runs the DKG through the
+control plane, waits for beacons, verifies every node agrees and every
+signature checks out against the distributed key (independently, over
+HTTP), optionally kills and restarts a node mid-run, then shuts down.
+Exit code 0 = every check passed. Reference: demo/lib/orchestrator.go.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+
+def log(*a):
+    print("[demo]", *a, flush=True)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def cli_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    return env
+
+
+def cli(*args, timeout=120):
+    return subprocess.run([sys.executable, "-m", "drand_tpu.cli", *args],
+                         capture_output=True, text=True, timeout=timeout,
+                         env=cli_env())
+
+
+class DemoNode:
+    def __init__(self, i: int, workdir: str):
+        self.i = i
+        self.folder = os.path.join(workdir, f"node{i}")
+        self.rpc = free_port()
+        self.ctl = free_port()
+        self.http = free_port()
+        self.addr = f"127.0.0.1:{self.rpc}"
+        self.proc: subprocess.Popen | None = None
+
+    def keygen(self):
+        out = cli("generate-keypair", "--folder", self.folder, self.addr)
+        if out.returncode != 0:
+            raise RuntimeError(f"keygen failed: {out.stderr}")
+
+    def start(self, dkg_timeout: float):
+        logfile = open(os.path.join(self.folder, "daemon.log"), "a")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "drand_tpu.cli", "start",
+             "--folder", self.folder, "--control", str(self.ctl),
+             "--public-listen", f"127.0.0.1:{self.http}",
+             "--dkg-timeout", str(dkg_timeout)],
+            stdout=logfile, stderr=subprocess.STDOUT, env=cli_env())
+        deadline = time.time() + 45
+        while time.time() < deadline:
+            ping = cli("util", "ping", "--control", str(self.ctl), timeout=10)
+            if ping.returncode == 0 and "pong" in ping.stdout:
+                return
+            time.sleep(0.3)
+        raise TimeoutError(f"daemon {self.addr} did not start")
+
+    def kill(self):
+        if self.proc is not None:
+            self.proc.send_signal(signal.SIGKILL)
+            self.proc.wait(timeout=10)
+            self.proc = None
+
+    def get(self, path: str):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{self.http}{path}", timeout=10) as r:
+            return json.loads(r.read())
+
+
+def verify_round(pub_hex: str, beacon: dict) -> bool:
+    """Independent verification against the distributed key (the demo's
+    CheckCurrentBeacon analogue, orchestrator.go:267-338)."""
+    from drand_tpu.chain.beacon import Beacon, verify_beacon, verify_beacon_v2
+    from drand_tpu.crypto.curves import PointG1
+
+    pub = PointG1.from_bytes(bytes.fromhex(pub_hex))
+    b = Beacon(round=beacon["round"],
+               previous_sig=bytes.fromhex(beacon["previous_signature"]),
+               signature=bytes.fromhex(beacon["signature"]),
+               signature_v2=bytes.fromhex(beacon.get("signature_v2", "")))
+    ok = verify_beacon(pub, b)
+    if ok and b.is_v2():
+        ok = verify_beacon_v2(pub, b)
+    return ok
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="drand-tpu-demo")
+    p.add_argument("--nodes", type=int, default=4)
+    p.add_argument("--threshold", type=int, default=3)
+    p.add_argument("--period", type=int, default=3)
+    p.add_argument("--rounds", type=int, default=4)
+    p.add_argument("--dkg-timeout", type=float, default=5.0)
+    p.add_argument("--kill-one", action="store_true",
+                   help="kill + restart one node mid-run")
+    p.add_argument("--workdir")
+    args = p.parse_args(argv)
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="drand-tpu-demo-")
+    log(f"workdir {workdir}")
+    nodes = [DemoNode(i, workdir) for i in range(args.nodes)]
+    try:
+        for n in nodes:
+            n.keygen()
+            n.start(args.dkg_timeout)
+        log(f"{args.nodes} daemons up")
+
+        secret_file = os.path.join(workdir, "secret")
+        with open(secret_file, "w") as f:
+            f.write("demo-secret-0123456789abcdef0000")
+
+        log("running DKG...")
+        share_procs = []
+        leader_args = ["share", "--control", str(nodes[0].ctl), "--leader",
+                       "--nodes", str(args.nodes),
+                       "--threshold", str(args.threshold),
+                       "--period", str(args.period),
+                       "--secret-file", secret_file, "--timeout", "45"]
+        share_procs.append(subprocess.Popen(
+            [sys.executable, "-m", "drand_tpu.cli", *leader_args],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=cli_env()))
+        for n in nodes[1:]:
+            share_procs.append(subprocess.Popen(
+                [sys.executable, "-m", "drand_tpu.cli", "share",
+                 "--control", str(n.ctl), "--connect", nodes[0].addr,
+                 "--secret-file", secret_file, "--timeout", "45"],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                env=cli_env()))
+        outs = [sp.communicate(timeout=300) for sp in share_procs]
+        for sp, (so, se) in zip(share_procs, outs):
+            if sp.returncode != 0:
+                raise RuntimeError(f"share failed:\n{so}\n{se}")
+        group = json.loads(outs[0][0])["group"]
+        pub_hex = group["public_key"][0]
+        log(f"DKG done; group key {pub_hex[:16]}… genesis "
+            f"{group['genesis_time']}")
+
+        log("waiting for beacons...")
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            try:
+                if nodes[0].get("/public/latest")["round"] >= 1:
+                    break
+            except Exception:
+                pass
+            time.sleep(1)
+
+        killed = None
+        for target in range(1, args.rounds + 1):
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                try:
+                    if nodes[0].get("/public/latest")["round"] >= target:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.5)
+            checks = []
+            for n in nodes:
+                if n.proc is None:
+                    continue
+                b = n.get(f"/public/{target}")
+                checks.append((n.addr, b["randomness"],
+                               verify_round(pub_hex, b)))
+            vals = {c[1] for c in checks}
+            oks = all(c[2] for c in checks)
+            log(f"round {target}: {len(checks)} nodes agree={len(vals) == 1} "
+                f"signatures_valid={oks}")
+            if len(vals) != 1 or not oks:
+                raise RuntimeError(f"round {target} check failed: {checks}")
+            if args.kill_one and target == 2 and killed is None:
+                killed = nodes[-1]
+                log(f"killing {killed.addr}")
+                killed.kill()
+            if args.kill_one and target == args.rounds - 1 and killed is not None:
+                log(f"restarting {killed.addr}")
+                killed.start(args.dkg_timeout)
+                killed = None
+
+        log("all checks passed")
+        for n in nodes:
+            if n.proc is not None:
+                cli("stop", "--control", str(n.ctl), timeout=20)
+        return 0
+    finally:
+        for n in nodes:
+            try:
+                n.kill()
+            except Exception:
+                pass
+        if args.workdir is None:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -124,26 +124,33 @@ class PublicServer:
             return web.json_response(result_json(await self._client.get(round_no)))
         except ClientError:
             pass
-        # long-poll: if it's the upcoming round, wait for it (server.go:102)
-        info = await self._client.info()
+        # long-poll ONLY the upcoming round (server.go:102); a missing
+        # historical round 404s immediately — blocking the watch timeout
+        # for arbitrary absent rounds would be free connection-holding
+        try:
+            info = await self._client.info()
+        except ClientError as e:
+            return web.json_response({"error": str(e)}, status=503)
         expected = time_math.current_round(
             int(self._clock.now()), info.period, info.genesis_time)
-        if round_no > expected + 1:
-            return web.json_response({"error": "round in the future"},
+        if round_no > expected + 1 or round_no < expected:
+            return web.json_response({"error": "round not available"},
                                      status=404)
         event = self._next_round_event
         try:
             await asyncio.wait_for(event.wait(), self._watch_timeout)
         except asyncio.TimeoutError:
-            return web.json_response({"error": "timeout waiting for round"},
-                                     status=404)
+            pass  # fall through: the round may have landed regardless
         try:
             return web.json_response(result_json(await self._client.get(round_no)))
         except ClientError as e:
             return web.json_response({"error": str(e)}, status=404)
 
     async def _handle_info(self, request: web.Request) -> web.Response:
-        info = await self._client.info()
+        try:
+            info = await self._client.info()
+        except ClientError as e:
+            return web.json_response({"error": str(e)}, status=503)
         return web.json_response({
             "public_key": info.public_key.to_bytes().hex(),
             "period": info.period,
@@ -154,7 +161,10 @@ class PublicServer:
 
     async def _handle_health(self, request: web.Request) -> web.Response:
         """Current vs expected round (http/server.go:351)."""
-        info = await self._client.info()
+        try:
+            info = await self._client.info()
+        except ClientError as e:
+            return web.json_response({"error": str(e)}, status=503)
         expected = time_math.current_round(
             int(self._clock.now()), info.period, info.genesis_time)
         current = self._latest.round if self._latest is not None else 0

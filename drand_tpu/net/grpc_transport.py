@@ -26,7 +26,7 @@ from .transport import ProtocolClient, ProtocolService, TransportError
 
 SERVICE = "drand.Protocol"
 _UNARY = ("GetIdentity", "SignalDKGParticipant", "PushDKGInfo",
-          "BroadcastDKG", "PartialBeacon", "ChainInfo")
+          "BroadcastDKG", "PartialBeacon", "ChainInfo", "PrivateRand")
 
 DEFAULT_TIMEOUT = 5.0
 SYNC_TIMEOUT = 600.0
@@ -73,6 +73,7 @@ class GrpcGateway:
             "BroadcastDKG": self._broadcast,
             "PartialBeacon": self._partial,
             "ChainInfo": self._chain_info,
+            "PrivateRand": self._private_rand,
         }[name]
 
         async def handler(request: bytes, context) -> bytes:
@@ -109,6 +110,10 @@ class GrpcGateway:
     async def _chain_info(self, msg, from_addr) -> bytes:
         info = await self._svc.chain_info(from_addr)
         return wire.encode(info)
+
+    async def _private_rand(self, msg, from_addr) -> bytes:
+        out = await self._svc.private_rand(from_addr, bytes(msg))
+        return wire.encode(wire.Blob(out))
 
     async def _sync_chain(self, request: bytes, context):
         try:
@@ -194,6 +199,11 @@ class GrpcClient(ProtocolClient):
         raw = await self._call(peer, "GetIdentity", b_empty())
         msg, _ = wire.decode(raw)
         return msg
+
+    async def private_rand(self, peer, request: bytes) -> bytes:
+        raw = await self._call(peer, "PrivateRand", wire.Blob(request))
+        msg, _ = wire.decode(raw)
+        return bytes(msg)
 
 
 def b_empty():

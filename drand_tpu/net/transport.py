@@ -50,6 +50,9 @@ class ProtocolClient:
     async def get_identity(self, peer) -> dict:
         raise NotImplementedError
 
+    async def private_rand(self, peer, request: bytes) -> bytes:
+        raise NotImplementedError
+
 
 class ProtocolService:
     """Inbound service surface a node registers on its transport
@@ -74,6 +77,9 @@ class ProtocolService:
         raise NotImplementedError
 
     async def get_identity(self, from_addr: str) -> dict:
+        raise NotImplementedError
+
+    async def private_rand(self, from_addr: str, request: bytes) -> bytes:
         raise NotImplementedError
 
 
@@ -151,3 +157,7 @@ class LocalClient(ProtocolClient):
     async def get_identity(self, peer) -> dict:
         svc = self._net._target(self._addr, peer)
         return await svc.get_identity(self._addr)
+
+    async def private_rand(self, peer, request: bytes) -> bytes:
+        svc = self._net._target(self._addr, peer)
+        return await svc.private_rand(self._addr, request)

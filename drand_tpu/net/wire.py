@@ -31,6 +31,10 @@ class WireError(Exception):
     pass
 
 
+class Blob(bytes):
+    """Raw-bytes payload (ECIES ciphertexts for PrivateRand)."""
+
+
 def _hex(b: bytes) -> str:
     return b.hex()
 
@@ -75,6 +79,10 @@ _codec("partial_beacon")((
 _codec("sync_request")((
     lambda r: {"from_round": r.from_round},
     lambda d: SyncRequest(from_round=int(d["from_round"]))))
+
+_codec("blob")((
+    lambda b: {"data": _hex(bytes(b))},
+    lambda d: Blob(_unhex(d["data"]))))
 
 _codec("beacon")((
     lambda b: {"round": b.round, "prev": _hex(b.previous_sig),
@@ -152,6 +160,7 @@ _codec("justification_bundle")((
         session_id=_unhex(d["session"]), signature=_unhex(d["sig"]))))
 
 _TYPE_OF = {
+    Blob: "blob",
     PartialBeaconPacket: "partial_beacon",
     SyncRequest: "sync_request",
     Beacon: "beacon",

@@ -263,6 +263,95 @@ def pt_mul_const(F, p, k: int):
     return acc
 
 
+def _pt_index(F, points, idx: int):
+    """Select point `idx` along the trailing points axis."""
+    return tuple(c[..., idx, :, :] if F.elem_ndim == 2 else c[..., idx, :]
+                 for c in points[:3]) + (points[3][..., idx],)
+
+
+def _pt_axis_pairs(F, pts, half: int):
+    """Split the trailing points axis in two halves and add elementwise."""
+    lo = tuple(c[..., :half, :, :] if F.elem_ndim == 2 else c[..., :half, :]
+               for c in pts[:3]) + (pts[3][..., :half],)
+    hi = tuple(c[..., half:, :, :] if F.elem_ndim == 2 else c[..., half:, :]
+               for c in pts[:3]) + (pts[3][..., half:],)
+    return pt_add(F, lo, hi)
+
+
+def msm_pippenger(F, points, bits, c: int = 4):
+    """Windowed (Pippenger) multi-scalar multiplication, latency-optimized
+    for the TPU: the naive interleaved ladder is nbits sequential rounds of
+    n sequential masked adds (depth ~ nbits*n point-adds); this runs one
+    lax.scan over the ~nbits/c windows whose body is bucket-select (cheap
+    masked moves), a log2(n)-depth tree reduction VECTORIZED across the
+    2^c-1 buckets, and a 2^c-depth weighted bucket combine — total depth
+    ~ (nbits/c) * (log2 n + 2^c + c) point-ops instead of nbits*n.
+
+    points: device point with batch shape (..., n); bits: (..., n, nbits)
+    MSB-first. Returns sum_i bits_i * points_i with batch shape (...,).
+    """
+    n = points[3].shape[-1]
+    nbits = bits.shape[-1]
+    batch_shape = points[3].shape[:-1]
+    nbuckets = (1 << c) - 1
+    nwin = -(-nbits // c)
+    pad_bits = nwin * c - nbits
+    if pad_bits:  # pad scalars at the MSB end with zeros
+        bits = jnp.concatenate(
+            [jnp.zeros(bits.shape[:-1] + (pad_bits,), bits.dtype), bits],
+            axis=-1)
+    # digits: (..., n, nwin), MSB window first
+    weights = jnp.asarray([1 << (c - 1 - j) for j in range(c)],
+                          dtype=bits.dtype)
+    digits = jnp.sum(bits.reshape(bits.shape[:-1] + (nwin, c)) *
+                     weights, axis=-1)
+    # pad the points axis to a power of two with infinity (tree reduce)
+    n_pad = 1 << max(1, (n - 1).bit_length())
+    p0 = _pt_index(F, points, 0)
+    if n_pad != n:
+        inf_tail = _pt_infinity_like(F, p0, batch_shape + (n_pad - n,))
+        points = tuple(
+            jnp.concatenate([a, b], axis=-(F.elem_ndim + 1))
+            for a, b in zip(points[:3], inf_tail[:3])
+        ) + (jnp.concatenate([points[3], inf_tail[3]], axis=-1),)
+        digits = jnp.concatenate(
+            [digits, jnp.zeros(batch_shape + (n_pad - n, nwin),
+                               digits.dtype)], axis=-2)
+
+    bucket_ids = jnp.arange(1, nbuckets + 1, dtype=digits.dtype)
+
+    def window_body(acc, digit_col):
+        # digit_col: (..., n_pad) — this window's digit per point
+        for _ in range(c):
+            acc = pt_dbl(F, acc)
+        # select each point into its bucket: shapes (..., nbuckets, n_pad)
+        in_bucket = digit_col[..., None, :] == bucket_ids[:, None]
+        sel = pt_select(
+            F, in_bucket,
+            tuple(jnp.expand_dims(comp, -(F.elem_ndim + 2))
+                  for comp in points[:3]) + (points[3][..., None, :],),
+            _pt_infinity_like(F, p0, batch_shape + (nbuckets, n_pad)))
+        # tree-reduce the points axis, vectorized across buckets
+        width = n_pad
+        while width > 1:
+            width //= 2
+            sel = _pt_axis_pairs(F, sel, width)
+        buckets = _pt_index(F, sel, 0)  # (..., nbuckets)
+        # weighted combine sum_b b*S_b via running suffix sums:
+        # running = S_max; total = S_max; then running += S_b, total += running
+        running = _pt_index(F, buckets, nbuckets - 1)
+        total = running
+        for b in range(nbuckets - 2, -1, -1):
+            running = pt_add(F, running, _pt_index(F, buckets, b))
+            total = pt_add(F, total, running)
+        return pt_add(F, acc, total), None
+
+    acc = _pt_infinity_like(F, p0, batch_shape)
+    xs = jnp.moveaxis(digits, -1, 0)  # (nwin, ..., n_pad)
+    acc, _ = jax.lax.scan(window_body, acc, xs)
+    return acc
+
+
 def msm(F, points, bits):
     """Multi-scalar multiplication over the trailing *points* axis.
 
@@ -271,6 +360,7 @@ def msm(F, points, bits):
 
     Interleaved double-and-add: one shared doubling chain for the
     accumulated sum — cost nbits doublings + nbits*n masked adds.
+    Prefer :func:`msm_pippenger` for n beyond a handful of points.
     """
     n = points[3].shape[-1]
     nbits = bits.shape[-1]

@@ -80,13 +80,45 @@ def _g2_aff(q: PointG2) -> np.ndarray:
 class BatchedEngine:
     """Stateful facade: owns the jitted graphs and the shape buckets."""
 
-    def __init__(self, buckets=DEFAULT_BUCKETS):
+    # recovery thresholds at or above this size use the Pippenger MSM
+    # (windowed buckets, log-depth tree reduction) instead of the
+    # interleaved ladder — the ladder's depth grows linearly with t
+    PIPPENGER_MIN_T = 16
+
+    def __init__(self, buckets=DEFAULT_BUCKETS,
+                 wire_prep: bool | None = None):
         self.buckets = tuple(sorted(buckets))
         self._verify = jax.jit(pairing.verify_prepared)
         self._msm_g2 = jax.jit(
             lambda pts, bits: curve.pt_to_affine(
                 curve.F2, curve.msm(curve.F2, pts, bits)))
+        self._msm_g2_pip = jax.jit(
+            lambda pts, bits: curve.pt_to_affine(
+                curve.F2, curve.msm_pippenger(curve.F2, pts, bits)))
         self._msg_cache: dict[tuple[bytes, bytes], PointG2] = {}
+        # wire-prep: hash-to-curve + decompression + subgroup checks run on
+        # the DEVICE (ops/h2c.py) instead of ~60ms/item of host Python —
+        # the catch-up throughput fix. Opt-in while the graph is young.
+        if wire_prep is None:
+            wire_prep = os.environ.get("DRAND_TPU_WIRE_PREP", "0") == "1"
+        self.wire_prep = wire_prep
+        self._verify_wire = jax.jit(self._wire_graph)
+
+    @staticmethod
+    def _wire_graph(pub_aff, sig_x, sig_sign, u_pairs):
+        """Fully-device verification from wire-format inputs: decompress +
+        subgroup-check the signatures, hash the messages to G2, run the
+        batched pairing check."""
+        from . import h2c
+
+        sig_pt, on_curve = h2c.decompress_g2_device(sig_x, sig_sign)
+        in_subgroup = h2c.subgroup_check_g2(sig_pt)
+        msg_pt = h2c.hash_to_g2_device(u_pairs)
+        mx, my, _ = curve.pt_to_affine(curve.F2, msg_pt)
+        sig_aff = jnp.stack([sig_pt[0], sig_pt[1]], axis=-3)
+        msg_aff = jnp.stack([mx, my], axis=-3)
+        ok = pairing.verify_prepared(pub_aff, sig_aff, msg_aff)
+        return ok & on_curve & in_subgroup
 
     # -- hashing (host, memoized: the aggregator re-verifies the same round
     #    message for every partial) -----------------------------------------
@@ -138,6 +170,20 @@ class BatchedEngine:
         (client/verify.go:146-163 made parallel). Returns per-beacon bools."""
         from ..chain import beacon as chain_beacon
 
+        if self.wire_prep:
+            checks = []  # (msg_bytes, sig_bytes)
+            spans = []
+            for bcn in beacons:
+                start = len(checks)
+                checks.append((chain_beacon.message(bcn.round,
+                                                    bcn.previous_sig),
+                               bcn.signature))
+                if bcn.is_v2():
+                    checks.append((chain_beacon.message_v2(bcn.round),
+                                   bcn.signature_v2))
+                spans.append((start, len(checks) - start))
+            flat = self.verify_wire(pubkey, checks, dst)
+            return np.array([bool(flat[s:s + c].all()) for s, c in spans])
         triples = []
         spans = []  # (start, count) per beacon
         for bcn in beacons:
@@ -152,6 +198,34 @@ class BatchedEngine:
             spans.append((start, len(triples) - start))
         flat = self.verify_bls(triples)
         return np.array([bool(flat[s:s + c].all()) for s, c in spans])
+
+    def verify_wire(self, pubkey: PointG1, checks,
+                    dst: bytes = DEFAULT_DST_G2) -> np.ndarray:
+        """Batch-verify (message bytes, compressed signature) pairs with
+        DEVICE-side hashing/decompression/subgroup checks (ops/h2c.py):
+        host work is only SHA-256 expansion and byte unpacking."""
+        from . import h2c
+
+        n = len(checks)
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        top = self.buckets[-1]
+        if n > top:
+            return np.concatenate([self.verify_wire(pubkey, checks[i:i + top],
+                                                    dst)
+                                   for i in range(0, n, top)])
+        b = _bucket(n, self.buckets)
+        pad_msg = b"drand-tpu-pad"
+        msgs = [m for m, _ in checks] + [pad_msg] * (b - n)
+        u = h2c.msgs_to_u(msgs, dst)
+        pad_sig = _PAD_SIG()
+        sigs = [s for _, s in checks] + [pad_sig] * (b - n)
+        xs, sign, valid = h2c.sigs_to_x(sigs)
+        pubs = np.broadcast_to(_g1_aff(pubkey), (b, 2, limb.NLIMBS))
+        ok = np.asarray(self._verify_wire(
+            jnp.asarray(pubs), jnp.asarray(xs), jnp.asarray(sign),
+            jnp.asarray(u)))
+        return (ok & valid)[:n]
 
     def verify_sigs(self, pubkey: PointG1, pairs,
                     dst: bytes = DEFAULT_DST_G2) -> list[bool]:
@@ -214,7 +288,9 @@ class BatchedEngine:
         z_one[:, 0] = np.asarray(limb.ONE_MONT)
         pts = (jnp.asarray(pts_np[:, 0]), jnp.asarray(pts_np[:, 1]),
                jnp.asarray(z_one), jnp.asarray(inf))
-        x_aff, y_aff, is_inf = self._msm_g2(pts, jnp.asarray(bits))
+        msm_fn = (self._msm_g2_pip if b >= self.PIPPENGER_MIN_T
+                  else self._msm_g2)
+        x_aff, y_aff, is_inf = msm_fn(pts, jnp.asarray(bits))
         if bool(np.asarray(is_inf)):
             raise ValueError("recovered signature is the point at infinity")
         from ..crypto.fields import Fp2
@@ -225,6 +301,17 @@ class BatchedEngine:
             Fp2.one(),
         )
         return rec.to_bytes()
+
+
+_PAD_SIG_BYTES: bytes | None = None
+
+
+def _PAD_SIG() -> bytes:
+    """A well-formed compressed G2 point for padding rows (sliced away)."""
+    global _PAD_SIG_BYTES
+    if _PAD_SIG_BYTES is None:
+        _PAD_SIG_BYTES = PointG2.generator().to_bytes()
+    return _PAD_SIG_BYTES
 
 
 def _decode_sig(sig_bytes: bytes) -> PointG2 | None:

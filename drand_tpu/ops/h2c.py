@@ -1,0 +1,252 @@
+"""Device hash-to-G2 and G2 decompression — the batch-prep pipeline.
+
+Host-side preparation was the bottleneck of batched verification: a pure-
+Python hash_to_g2 costs ~45ms per message and a subgroup-checked
+decompression ~18ms per signature, capping any catch-up batch at ~15
+beacons/s regardless of device speed. This module moves everything after
+the SHA-256 message expansion onto the device:
+
+  host:   expand_message_xmd (SHA-256) -> two Fp2 u-values per message;
+          signature bytes -> x-coordinate limbs + sign flag
+  device: simplified SWU onto E' -> derived 3-isogeny -> E2 -> cofactor
+          clearing (one scan);  sqrt-based decompression with the zcash
+          lexicographic sign rule;  r-order subgroup checks (one scan)
+
+Mirrors drand_tpu.crypto.hash_to_curve (RFC 9380 pipeline, constants
+imported from the host derivation so the two paths cannot diverge) and
+crypto.curves.PointG2.from_bytes semantics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..crypto import hash_to_curve as h2c_host
+from ..crypto.fields import P, R
+from ..crypto.hash_to_curve import (
+    DEFAULT_DST_G2,
+    _A_PRIME,
+    _B_PRIME,
+    _B_OVER_ZA,
+    _H_CLEAR,
+    _ISO_PARAMS,
+    _MINUS_B_OVER_A,
+    _Z_SSWU,
+    hash_to_field_fp2,
+)
+from . import curve, limb, tower
+from .tower import (
+    f2_add,
+    f2_eq,
+    f2_is_zero,
+    f2_inv,
+    f2_mul,
+    f2_mul_small,
+    f2_neg,
+    f2_pow_const,
+    f2_select,
+    f2_sqr,
+    f2_sub,
+)
+
+# ---------------------------------------------------------------------------
+# Device constants (mont limbs) from the host-derived parameters
+# ---------------------------------------------------------------------------
+
+def _c_f2(x) -> np.ndarray:
+    return np.stack([limb.int_to_mont_limbs(x.c0), limb.int_to_mont_limbs(x.c1)])
+
+
+_A_P = _c_f2(_A_PRIME)
+_B_P = _c_f2(_B_PRIME)
+_Z_C = _c_f2(_Z_SSWU)
+_MBA = _c_f2(_MINUS_B_OVER_A)
+_BZA = _c_f2(_B_OVER_ZA)
+_X0, _V_SUM, _U_SUM, _C2, _C3 = (_c_f2(v) for v in _ISO_PARAMS)
+_B_G2 = _c_f2(type(_A_PRIME)(4, 4))
+
+# sqrt in Fp2 (q = p^2 ≡ 9 mod 16): candidate a^((q+7)/16) times a 4th root
+# of unity (crypto/fields.py Fp2.sqrt)
+_SQRT_EXP = (P * P + 7) // 16
+from ..crypto.fields import _FP2_ROOTS_OF_UNITY_4  # noqa: E402
+
+_ROOTS4 = np.stack([_c_f2(r) for r in _FP2_ROOTS_OF_UNITY_4])
+
+_H_BITS = curve.scalar_to_bits(_H_CLEAR, _H_CLEAR.bit_length())
+_R_BITS = curve.scalar_to_bits(R, 255)
+
+# (p-1)/2 exact limbs, for the G1-style parity checks if ever needed
+_HALF_P = np.asarray(limb.int_to_limbs((P - 1) // 2))
+
+
+# ---------------------------------------------------------------------------
+# Field helpers
+# ---------------------------------------------------------------------------
+
+def _sqrt_f2(a):
+    """(root, is_square) — candidate exponentiation + 4th-root correction.
+    a must follow the engine invariant; root is in Montgomery form."""
+    cand = f2_pow_const(a, _SQRT_EXP)
+    roots = jnp.asarray(_ROOTS4)
+    best = None
+    found = None
+    for i in range(roots.shape[0]):
+        r = f2_mul(cand, jnp.broadcast_to(roots[i], cand.shape))
+        ok = f2_eq(f2_sqr(r), a)
+        if best is None:
+            best, found = r, ok
+        else:
+            best = f2_select(ok, r, best)
+            found = found | ok
+    return best, found
+
+
+def _canonical_f2(a):
+    """Exact canonical (non-Montgomery) limbs of an Fp2 element: (c0, c1)
+    each (..., NLIMBS)."""
+    raw_c0 = limb.from_mont(a[..., 0, :])
+    raw_c1 = limb.from_mont(a[..., 1, :])
+    return limb.canonicalize(raw_c0), limb.canonicalize(raw_c1)
+
+
+def _sgn0_f2(a):
+    """RFC 9380 sgn0 for Fp2 (fields.py Fp2.sgn0) on canonical limbs."""
+    c0, c1 = _canonical_f2(a)
+    sign0 = c0[..., 0] & 1
+    zero0 = jnp.all(c0 == 0, axis=-1)
+    sign1 = c1[..., 0] & 1
+    return (sign0.astype(bool)) | (zero0 & sign1.astype(bool))
+
+
+def _lex_largest_f2(y):
+    """zcash rule (curves.py PointG2._y_is_lexicographically_largest):
+    compare (c1, c0) of y against -y."""
+    yc0, yc1 = _canonical_f2(y)
+    ny = f2_neg(y)
+    nc0, nc1 = _canonical_f2(ny)
+    c1_gt = limb._lex_ge(yc1, nc1) & ~jnp.all(yc1 == nc1, axis=-1)
+    c1_eq = jnp.all(yc1 == nc1, axis=-1)
+    c0_gt = limb._lex_ge(yc0, nc0) & ~jnp.all(yc0 == nc0, axis=-1)
+    return c1_gt | (c1_eq & c0_gt)
+
+
+# ---------------------------------------------------------------------------
+# SSWU + isogeny + cofactor clearing
+# ---------------------------------------------------------------------------
+
+def map_to_curve_g2(u):
+    """u: (..., 2, 32) Fp2 mont limbs -> affine (x, y) on E2 (pre-cofactor).
+    Branch-free SSWU (RFC 9380 §6.6.2) then the derived 3-isogeny."""
+    a_p = jnp.asarray(_A_P)
+    b_p = jnp.asarray(_B_P)
+    zu2 = f2_mul(jnp.asarray(_Z_C), f2_sqr(u))
+    tv = f2_add(f2_sqr(zu2), zu2)
+    tv_zero = f2_is_zero(tv)
+    # guard the inversion against tv == 0 (inv(0) = 0 is harmless but the
+    # select must pick the exceptional constant)
+    x1_main = f2_mul(jnp.asarray(_MBA),
+                     f2_add(tower.f2_one() + tv * 0, f2_inv(tv)))
+    x1 = f2_select(tv_zero, jnp.broadcast_to(jnp.asarray(_BZA), x1_main.shape),
+                   x1_main)
+
+    def g_prime(x):
+        return f2_add(f2_add(f2_mul(f2_sqr(x), x), f2_mul(a_p, x)), b_p)
+
+    gx1 = g_prime(x1)
+    y1, sq1 = _sqrt_f2(gx1)
+    x2 = f2_mul(zu2, x1)
+    gx2 = g_prime(x2)
+    y2, _ = _sqrt_f2(gx2)
+    x = f2_select(sq1, x1, x2)
+    y = f2_select(sq1, y1, y2)
+    # sign: sgn0(y) must equal sgn0(u)
+    flip = _sgn0_f2(u) != _sgn0_f2(y)
+    y = f2_select(flip, f2_neg(y), y)
+    # 3-isogeny + isomorphism onto E2 (hash_to_curve._iso_apply)
+    d = f2_sub(x, jnp.asarray(_X0))
+    dinv = f2_inv(d)
+    dinv2 = f2_sqr(dinv)
+    X = f2_add(x, f2_add(f2_mul(jnp.asarray(_V_SUM), dinv),
+                         f2_mul(jnp.asarray(_U_SUM), dinv2)))
+    one = tower.f2_one() + x * 0
+    Y = f2_mul(y, f2_sub(one, f2_add(
+        f2_mul(jnp.asarray(_V_SUM), dinv2),
+        f2_mul(f2_mul_small(jnp.asarray(_U_SUM), 2), f2_mul(dinv2, dinv)))))
+    return f2_mul(jnp.asarray(_C2), X), f2_mul(jnp.asarray(_C3), Y)
+
+
+def hash_to_g2_device(u_pairs):
+    """u_pairs: (..., 2, 2, 32) — TWO Fp2 u-values per message (RFC
+    hash_to_curve is map(u0) + map(u1)). Returns a device G2 point (the
+    full point tuple) in the r-order subgroup."""
+    x0, y0 = map_to_curve_g2(u_pairs[..., 0, :, :])
+    x1, y1 = map_to_curve_g2(u_pairs[..., 1, :, :])
+    one_z = tower.f2_one() + x0 * 0
+    inf = jnp.zeros(x0.shape[:-2], bool) | (x0[..., 0, 0] * 0).astype(bool)
+    p0 = (x0, y0, one_z, inf)
+    p1 = (x1, y1, one_z, inf)
+    q = curve.pt_add(curve.F2, p0, p1)
+    bits = jnp.asarray(_H_BITS)
+    return curve.pt_mul_bits(curve.F2, q, bits)
+
+
+def msgs_to_u(msgs: list[bytes], dst: bytes = DEFAULT_DST_G2) -> np.ndarray:
+    """Host: SHA-256 expansion of each message to its two Fp2 u-values,
+    packed as (n, 2, 2, 32) mont limbs — the only host step of hashing."""
+    out = np.zeros((len(msgs), 2, 2, limb.NLIMBS), np.int32)
+    for i, msg in enumerate(msgs):
+        u0, u1 = hash_to_field_fp2(msg, dst, 2)
+        out[i, 0] = _c_f2(u0)
+        out[i, 1] = _c_f2(u1)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Decompression + subgroup check
+# ---------------------------------------------------------------------------
+
+def sigs_to_x(sigs: list[bytes]) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host: split 96-byte compressed G2 signatures into x limbs (mont),
+    the sign flag, and a validity mask (header bits / range checks).
+    zcash layout: byte0 top bits = [compressed, infinity, sign]."""
+    n = len(sigs)
+    xs = np.zeros((n, 2, limb.NLIMBS), np.int32)  # (n, [c0,c1], limbs)
+    sign = np.zeros(n, bool)
+    valid = np.zeros(n, bool)
+    for i, s in enumerate(sigs):
+        if len(s) != 96:
+            continue
+        b0 = s[0]
+        if not (b0 & 0x80) or (b0 & 0x40):  # must be compressed, not inf
+            continue
+        c1 = int.from_bytes(bytes([b0 & 0x1F]) + s[1:48], "big")
+        c0 = int.from_bytes(s[48:96], "big")
+        if c0 >= P or c1 >= P:
+            continue
+        xs[i, 0] = limb.int_to_mont_limbs(c0)
+        xs[i, 1] = limb.int_to_mont_limbs(c1)
+        sign[i] = bool(b0 & 0x20)
+        valid[i] = True
+    return xs, sign, valid
+
+
+def decompress_g2_device(x, sign_bit):
+    """x: (..., 2, 32) mont limbs; sign_bit: (...,) bool (lexicographically
+    largest y). Returns (point, ok): ok=False where x is not on the curve.
+    The r-order subgroup check is separate (subgroup_check_g2)."""
+    gx = f2_add(f2_mul(f2_sqr(x), x), jnp.asarray(_B_G2))
+    y, on_curve = _sqrt_f2(gx)
+    is_largest = _lex_largest_f2(y)
+    y = f2_select(jnp.not_equal(is_largest, sign_bit), f2_neg(y), y)
+    one_z = tower.f2_one() + x * 0
+    inf = jnp.zeros(x.shape[:-2], bool) | (x[..., 0, 0] * 0).astype(bool)
+    return (x, y, one_z, inf), on_curve
+
+
+def subgroup_check_g2(pt):
+    """[r]Q == O — the r-order check from PointG2.from_bytes."""
+    bits = jnp.asarray(_R_BITS)
+    out = curve.pt_mul_bits(curve.F2, pt, bits)
+    return out[3]  # infinity flag

@@ -359,6 +359,44 @@ def eq_mod_p(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return is_zero_mod_p(sub(a, b))
 
 
+def _lex_ge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a >= b for little-endian EXACT limb vectors (same trailing length):
+    the most significant differing limb decides."""
+    eq = a == b
+    gt = a > b
+    # all limbs ABOVE position j equal: reversed-cumprod trick
+    eq_rev = jnp.flip(eq, -1)
+    higher_eq = jnp.concatenate(
+        [jnp.ones_like(eq_rev[..., :1]),
+         jnp.cumprod(eq_rev[..., :-1].astype(DTYPE), axis=-1).astype(bool)],
+        axis=-1)
+    gt_rev = jnp.flip(gt, -1)
+    return jnp.any(gt_rev & higher_eq, axis=-1) | jnp.all(eq, axis=-1)
+
+
+def canonicalize(a: jnp.ndarray) -> jnp.ndarray:
+    """Exact canonical form: limbs of (value mod p), each in [0, MASK],
+    shape (..., NLIMBS). Needed wherever the INTEGER value matters (sgn0,
+    lexicographic y selection, serialization) — the engine invariant only
+    guarantees the value mod p."""
+    norm = exact_normalize(a)  # (..., 33) exact, value < ~2^385
+    mults = jnp.asarray(_P_MULTIPLES)  # (K, 33): k*p for k = 0..K-1
+    ge = _lex_ge(norm[..., None, :], mults)  # (..., K)
+    k = jnp.sum(ge.astype(DTYPE), axis=-1) - 1  # value in [k*p, (k+1)*p)
+    diffs = norm[..., None, :] - mults  # (..., K, 33), limbs possibly < 0
+
+    def borrow_step(carry, x):
+        s = x + carry
+        return s >> BITS, s & MASK
+
+    xs = jnp.moveaxis(diffs, -1, 0)
+    _, ys = jax.lax.scan(borrow_step, diffs[..., 0] * 0, xs)
+    fixed = jnp.moveaxis(ys, 0, -1)  # exact non-negative for the right k
+    onehot = (jnp.arange(mults.shape[0]) == k[..., None]).astype(DTYPE)
+    return jnp.sum(fixed * onehot[..., None], axis=-2,
+                   dtype=DTYPE)[..., :NLIMBS]
+
+
 # ---------------------------------------------------------------------------
 # Fixed-exponent powering (device, scanned over a host-fixed bit pattern)
 # ---------------------------------------------------------------------------

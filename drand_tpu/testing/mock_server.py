@@ -37,7 +37,7 @@ class MockBeaconServer(Client):
         self._pub = poly.commit()
         shares = poly.shares(2)
         self._shares = shares
-        self.info = Info(
+        self.chain_info = Info(
             public_key=self._pub.commit(),
             period=period,
             genesis_time=genesis_time,
@@ -45,7 +45,7 @@ class MockBeaconServer(Client):
             group_hash=b"\x77" * 32,
         )
         self.beacons: dict[int, Beacon] = {}
-        prev = self.info.genesis_seed
+        prev = self.chain_info.genesis_seed
         for rnd in range(1, nrounds + 1):
             msg = message(rnd, prev)
             partials = [tbls.sign_partial(s, msg) for s in shares]
@@ -106,15 +106,12 @@ class MockBeaconServer(Client):
         finally:
             self._watchers.remove(q)
 
-    async def info_(self) -> Info:
-        return self.info
-
     async def info(self) -> Info:  # Client surface
-        return self.info
+        return self.chain_info
 
     def round_at(self, t: float) -> int:
-        return time_math.current_round(int(t), self.info.period,
-                                       self.info.genesis_time)
+        return time_math.current_round(int(t), self.chain_info.period,
+                                       self.chain_info.genesis_time)
 
     # -------------------------------------------- sync service (server side)
     async def sync_chain(self, from_addr: str, req):

@@ -17,13 +17,13 @@ from drand_tpu.testing.mock_server import MockBeaconServer
 @pytest.mark.asyncio
 async def test_mock_server_chain_is_real():
     mock = MockBeaconServer(nrounds=6)
-    pub = mock.info.public_key
+    pub = mock.chain_info.public_key
     for rnd in range(1, 7):
         b = mock.beacons[rnd]
         assert verify_beacon(pub, b)
         assert verify_beacon_v2(pub, b)
     # the verified client stack accepts it end to end (strict chain walk)
-    client = new_client([mock], chain_info=mock.info, strict_rounds=True)
+    client = new_client([mock], chain_info=mock.chain_info, strict_rounds=True)
     r = await client.get(6)
     assert r.round == 6
 
@@ -31,12 +31,12 @@ async def test_mock_server_chain_is_real():
 @pytest.mark.asyncio
 async def test_mock_server_corruption_switch():
     mock = MockBeaconServer(nrounds=5, bad_second_round=True)
-    client = new_client([mock], chain_info=mock.info)
+    client = new_client([mock], chain_info=mock.chain_info)
     assert (await client.get(3)).round == 3
     with pytest.raises(ClientError):
         await client.get(2)
     # strict mode: the corrupted round poisons later rounds' history walk
-    strict = new_client([mock], chain_info=mock.info, strict_rounds=True)
+    strict = new_client([mock], chain_info=mock.chain_info, strict_rounds=True)
     with pytest.raises(ClientError):
         await strict.get(5)
 
@@ -46,7 +46,7 @@ async def test_mock_server_emit_extends_chain():
     mock = MockBeaconServer(nrounds=3)
     b = mock.emit()
     assert b.round == 4
-    assert verify_beacon(mock.info.public_key, b)
+    assert verify_beacon(mock.chain_info.public_key, b)
     assert (await mock.get(0)).round == 4
 
 

@@ -1,0 +1,52 @@
+"""Pippenger MSM golden tests vs the host reference.
+
+Small scalar widths keep suite compile time bounded while exercising every
+structural element (windowing, bucket select, tree reduction with infinity
+padding, suffix-sum combine, window doubling chain); the full 255-bit G2
+shape is exercised by the engine recovery path and bench.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from drand_tpu.crypto.curves import PointG1, PointG2
+from drand_tpu.crypto.fields import R
+from drand_tpu.ops import curve
+
+NBITS = 40
+
+
+def _bits(k: int) -> np.ndarray:
+    return curve.scalar_to_bits(k, NBITS)
+
+
+@pytest.mark.parametrize("n,cls", [(6, PointG1), (5, PointG2)])
+def test_pippenger_matches_host(n, cls):
+    rng = random.Random(1000 + n)
+    F = curve.F1 if cls is PointG1 else curve.F2
+    conv = curve.g1_to_device if cls is PointG1 else curve.g2_to_device
+    back = curve.g1_from_device if cls is PointG1 else curve.g2_from_device
+    pts = [cls.generator().mul(rng.randrange(1, R)) for _ in range(n)]
+    ks = [rng.randrange(0, 1 << NBITS) for _ in range(n)]
+    ptd = curve.stack_points([conv(p) for p in pts])
+    bits = jnp.asarray(np.stack([_bits(k) for k in ks]))
+    got = jax.jit(lambda p, b: curve.msm_pippenger(F, p, b))(ptd, bits)
+    host = cls.msm(ks, pts)
+    assert back(tuple(np.asarray(x) for x in got)) == host
+
+
+def test_pippenger_zero_scalars_and_infinity_points():
+    rng = random.Random(7)
+    pts = [PointG1.generator().mul(rng.randrange(1, R)) for _ in range(3)]
+    pts.append(PointG1.infinity())
+    ks = [0, rng.randrange(1, 1 << NBITS), 0, rng.randrange(1, 1 << NBITS)]
+    ptd = curve.stack_points([curve.g1_to_device(p) for p in pts])
+    bits = jnp.asarray(np.stack([_bits(k) for k in ks]))
+    got = jax.jit(lambda p, b: curve.msm_pippenger(curve.F1, p, b))(ptd, bits)
+    host = PointG1.msm(ks, pts)
+    assert curve.g1_from_device(tuple(np.asarray(x) for x in got)) == host

@@ -1,0 +1,83 @@
+"""Private randomness (ECIES) and timelock-to-round client surfaces.
+
+Reference: core/drand_public.go:126-160 (PrivateRand) and
+core/timelock_test.go:17-72 (timelock encryption over V2 signatures).
+"""
+
+import pytest
+
+from drand_tpu.client import ClientError
+from drand_tpu.client.direct import DirectClient
+from drand_tpu.client.private import private_rand
+from drand_tpu.client.timelock import (
+    decrypt_with_beacon,
+    dumps,
+    encrypt_to_round,
+    loads,
+)
+from drand_tpu.testing.harness import BeaconTestNetwork
+
+N, T, PERIOD = 3, 2, 5
+
+
+@pytest.mark.asyncio
+async def test_private_rand_roundtrip():
+    net = BeaconTestNetwork(n=N, t=T, period=PERIOD)
+    # private rand needs only identities + transport, not a running chain
+    client = net.network.client_for("consumer:1")
+
+    class _Consumer:
+        async def private_rand(self, f, r):  # pragma: no cover
+            raise NotImplementedError
+
+    net.network.register("consumer:1", _Consumer())
+
+    # wire the daemon-side handler onto node 0's service: the harness
+    # registers the beacon Handler, which lacks private_rand — attach the
+    # daemon implementation shape directly
+    from drand_tpu.crypto import ecies
+    from drand_tpu.crypto.curves import PointG1
+    from drand_tpu.utils import entropy
+
+    node_pair = net.pairs[0]
+
+    async def _private_rand(from_addr, request):
+        client_key = PointG1.from_bytes(
+            ecies.decrypt(node_pair.key, bytes(request)))
+        return ecies.encrypt(client_key, entropy.get_random(32))
+
+    net.nodes[0].handler.private_rand = _private_rand
+
+    out1 = await private_rand(client, net.pairs[0].public)
+    out2 = await private_rand(client, net.pairs[0].public)
+    assert len(out1) == 32 and out1 != out2
+
+
+@pytest.mark.asyncio
+async def test_timelock_round_trip_and_wrong_round():
+    net = BeaconTestNetwork(n=N, t=T, period=PERIOD)
+    await net.start_all()
+    await net.advance_to_genesis()
+    for _ in range(3):
+        await net.clock.advance(PERIOD)
+    for i in range(N):
+        await net.wait_round(i, 3)
+    try:
+        src = DirectClient(net.nodes[0].handler)
+        info = await src.info()
+        secret = b"the launch code is 0000"
+        ct = loads(dumps(encrypt_to_round(info, 3, secret)))
+        r3 = await src.get(3)
+        assert decrypt_with_beacon(ct, r3) == secret
+        # the wrong round's signature must not decrypt
+        r2 = await src.get(2)
+        with pytest.raises(ClientError):
+            decrypt_with_beacon(ct, r2)
+        # tampering is rejected by the FO check
+        ct_bad = dict(ct)
+        ct_bad["W"] = ct["W"][:-4] + ("AAA=" if not ct["W"].endswith("AAA=")
+                                      else "BBB=")
+        with pytest.raises(Exception):
+            decrypt_with_beacon(ct_bad, r3)
+    finally:
+        net.stop_all()
