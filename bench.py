@@ -51,7 +51,7 @@ def main() -> None:
     from drand_tpu.ops import limb, pairing
 
     batches = [int(b) for b in
-               os.environ.get("BENCH_BATCH", "64,16").split(",")]
+               os.environ.get("BENCH_BATCH", "64,16,8,4").split(",")]
     min_seconds = float(os.environ.get("BENCH_MIN_SECONDS", "5.0"))
     log(f"backend={jax.default_backend()} devices={jax.devices()} "
         f"batches={batches}")

@@ -117,7 +117,16 @@ async def _serve_public(d, listen: str, logger) -> None:
     while d.beacon is None:
         await asyncio.sleep(0.5)
     host, port = listen.rsplit(":", 1)
-    server = PublicServer(DirectClient(d.beacon), logger=logger.named("http"))
+
+    async def peer_metrics(addr: str) -> bytes:
+        # only group members may be scraped through us (metrics.go:269)
+        group = d.group
+        if group is None or not any(n.address() == addr for n in group.nodes):
+            raise ValueError(f"{addr} is not a group member")
+        return await d.client.peer_metrics(addr)
+
+    server = PublicServer(DirectClient(d.beacon), logger=logger.named("http"),
+                          peer_metrics_fn=peer_metrics)
     await server.start(host or "0.0.0.0", int(port))
     logger.info("http", "serving", listen=listen)
     await asyncio.Event().wait()
@@ -249,6 +258,9 @@ def cmd_util(args) -> None:
     if args.what == "del-beacon":
         # offline rollback (reference cli.go:651 deleteBeaconCmd): daemon
         # must be stopped; removes every round >= --round
+        if args.round is None:
+            raise SystemExit("del-beacon requires --round (every round >= "
+                             "it is deleted)")
         from ..chain.store import SQLiteStore, StoreError
 
         db = os.path.join(_folder(args), "db", "chain.db")
@@ -382,7 +394,7 @@ def main(argv=None) -> None:
     u.add_argument("--control", type=int, default=8888)
     u.add_argument("--address")
     u.add_argument("--folder")
-    u.add_argument("--round", type=int, default=1)
+    u.add_argument("--round", type=int, default=None)
     u.set_defaults(fn=cmd_util)
 
     r = sub.add_parser("relay")

@@ -392,6 +392,13 @@ class Drand(ProtocolService):
     async def get_identity(self, from_addr: str):
         return self.priv.public
 
+    async def peer_metrics(self, from_addr: str) -> bytes:
+        """Serve our prometheus metrics to group members over the node
+        transport (core/drand_metrics.go:12 PeerMetrics)."""
+        from .. import metrics
+
+        return metrics.render()
+
     async def private_rand(self, from_addr: str, request: bytes) -> bytes:
         """ECIES private randomness (core/drand_public.go:126-160): decrypt
         the requester's ephemeral key with our longterm key, return 32

@@ -112,6 +112,73 @@ def verify_round(pub_hex: str, beacon: dict) -> bool:
     return ok
 
 
+def run_reshare(args, nodes, workdir, secret_file, pub_hex, group) -> None:
+    """Reshare plan (orchestrator.go:398 RunResharing): add K fresh nodes,
+    run the resharing through the control plane, cross the transition, and
+    verify the distributed key is UNCHANGED while the group grew."""
+    import json as _json
+
+    k = args.reshare_add
+    new_n = len(nodes) + k
+    new_thr = max(args.threshold + k // 2, new_n // 2 + 1)
+    log(f"resharing to {new_n} nodes (threshold {new_thr})...")
+    joiners = [DemoNode(len(nodes) + j, workdir) for j in range(k)]
+    for j in joiners:
+        j.keygen()
+        j.start(args.dkg_timeout)
+    group_file = os.path.join(workdir, "old_group.json")
+    with open(group_file, "w") as f:
+        _json.dump(group, f)
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "drand_tpu.cli", "share",
+         "--control", str(nodes[0].ctl), "--leader", "--reshare",
+         "--nodes", str(new_n), "--threshold", str(new_thr),
+         "--secret-file", secret_file, "--timeout", "45"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=cli_env())]
+    for n in nodes[1:]:
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "drand_tpu.cli", "share",
+             "--control", str(n.ctl), "--connect", nodes[0].addr,
+             "--reshare", "--secret-file", secret_file, "--timeout", "45"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=cli_env()))
+    for j in joiners:
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "drand_tpu.cli", "share",
+             "--control", str(j.ctl), "--connect", nodes[0].addr,
+             "--reshare", "--from-group", group_file,
+             "--secret-file", secret_file, "--timeout", "45"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=cli_env()))
+    outs = [sp.communicate(timeout=300) for sp in procs]
+    for sp, (so, se) in zip(procs, outs):
+        if sp.returncode != 0:
+            raise RuntimeError(f"reshare share failed:\n{so}\n{se}")
+    new_group = _json.loads(outs[0][0])["group"]
+    assert new_group["public_key"][0] == pub_hex, \
+        "distributed key changed across reshare!"
+    assert len(new_group["nodes"]) == new_n
+    log(f"reshare done; key preserved, transition at "
+        f"{new_group['transition_time']}")
+    # cross the transition and verify a post-transition round on a joiner
+    deadline = new_group["transition_time"] + args.period * 3 + 60
+    target = None
+    while time.time() < deadline:
+        try:
+            latest = joiners[0].get("/public/latest")
+            if latest["round"] and time.time() > new_group["transition_time"]:
+                target = latest
+                break
+        except Exception:
+            pass
+        time.sleep(1)
+    assert target is not None, "joiner never served post-transition rounds"
+    assert verify_round(pub_hex, target), "post-transition beacon invalid"
+    log(f"post-transition round {target['round']} verified on a joiner")
+    nodes.extend(joiners)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="drand-tpu-demo")
     p.add_argument("--nodes", type=int, default=4)
@@ -121,6 +188,10 @@ def main(argv=None) -> int:
     p.add_argument("--dkg-timeout", type=float, default=5.0)
     p.add_argument("--kill-one", action="store_true",
                    help="kill + restart one node mid-run")
+    p.add_argument("--reshare-add", type=int, default=0, metavar="K",
+                   help="after the rounds, reshare to nodes+K members "
+                        "(threshold grows by K//2) and verify the chain "
+                        "identity survives")
     p.add_argument("--workdir")
     args = p.parse_args(argv)
 
@@ -205,6 +276,9 @@ def main(argv=None) -> int:
                 log(f"restarting {killed.addr}")
                 killed.start(args.dkg_timeout)
                 killed = None
+
+        if args.reshare_add:
+            run_reshare(args, nodes, workdir, secret_file, pub_hex, group)
 
         log("all checks passed")
         for n in nodes:

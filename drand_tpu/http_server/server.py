@@ -44,11 +44,15 @@ def result_json(r: Result) -> dict:
 class PublicServer:
     def __init__(self, client: Client, clock: Clock | None = None,
                  logger: KVLogger | None = None,
-                 watch_timeout: float = 30.0):
+                 watch_timeout: float = 30.0,
+                 peer_metrics_fn=None):
         self._client = client
         self._clock = clock or SystemClock()
         self._l = logger or default_logger("http")
         self._watch_timeout = watch_timeout
+        # optional async addr -> bytes hook relaying a group member's
+        # metrics over the node transport (metrics.go:266 GroupHandler)
+        self._peer_metrics_fn = peer_metrics_fn
         self._latest: Result | None = None
         self._next_round_event = asyncio.Event()
         self._watch_task: asyncio.Task | None = None
@@ -59,6 +63,7 @@ class PublicServer:
             web.get("/info", self._handle_info),
             web.get("/health", self._handle_health),
             web.get("/metrics", self._handle_metrics),
+            web.get("/peer/{addr}/metrics", self._handle_peer_metrics),
         ])
 
     # ------------------------------------------------------------ serving
@@ -107,6 +112,16 @@ class PublicServer:
 
         return web.Response(body=metrics.render(),
                             content_type="text/plain")
+
+    async def _handle_peer_metrics(self, request: web.Request) -> web.Response:
+        if self._peer_metrics_fn is None:
+            return web.json_response({"error": "peer metrics not enabled"},
+                                     status=404)
+        try:
+            body = await self._peer_metrics_fn(request.match_info["addr"])
+        except Exception as e:  # noqa: BLE001 — peer unreachable etc.
+            return web.json_response({"error": str(e)}, status=502)
+        return web.Response(body=body, content_type="text/plain")
 
     async def _handle_latest(self, request: web.Request) -> web.Response:
         try:

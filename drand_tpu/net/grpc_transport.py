@@ -26,7 +26,8 @@ from .transport import ProtocolClient, ProtocolService, TransportError
 
 SERVICE = "drand.Protocol"
 _UNARY = ("GetIdentity", "SignalDKGParticipant", "PushDKGInfo",
-          "BroadcastDKG", "PartialBeacon", "ChainInfo", "PrivateRand")
+          "BroadcastDKG", "PartialBeacon", "ChainInfo", "PrivateRand",
+          "Metrics")
 
 DEFAULT_TIMEOUT = 5.0
 SYNC_TIMEOUT = 600.0
@@ -74,6 +75,7 @@ class GrpcGateway:
             "PartialBeacon": self._partial,
             "ChainInfo": self._chain_info,
             "PrivateRand": self._private_rand,
+            "Metrics": self._peer_metrics,
         }[name]
 
         async def handler(request: bytes, context) -> bytes:
@@ -114,6 +116,9 @@ class GrpcGateway:
     async def _private_rand(self, msg, from_addr) -> bytes:
         out = await self._svc.private_rand(from_addr, bytes(msg))
         return wire.encode(wire.Blob(out))
+
+    async def _peer_metrics(self, msg, from_addr) -> bytes:
+        return wire.encode(wire.Blob(await self._svc.peer_metrics(from_addr)))
 
     async def _sync_chain(self, request: bytes, context):
         try:
@@ -202,6 +207,11 @@ class GrpcClient(ProtocolClient):
 
     async def private_rand(self, peer, request: bytes) -> bytes:
         raw = await self._call(peer, "PrivateRand", wire.Blob(request))
+        msg, _ = wire.decode(raw)
+        return bytes(msg)
+
+    async def peer_metrics(self, peer) -> bytes:
+        raw = await self._call(peer, "Metrics", b_empty())
         msg, _ = wire.decode(raw)
         return bytes(msg)
 

@@ -53,6 +53,9 @@ class ProtocolClient:
     async def private_rand(self, peer, request: bytes) -> bytes:
         raise NotImplementedError
 
+    async def peer_metrics(self, peer) -> bytes:
+        raise NotImplementedError
+
 
 class ProtocolService:
     """Inbound service surface a node registers on its transport
@@ -80,6 +83,9 @@ class ProtocolService:
         raise NotImplementedError
 
     async def private_rand(self, from_addr: str, request: bytes) -> bytes:
+        raise NotImplementedError
+
+    async def peer_metrics(self, from_addr: str) -> bytes:
         raise NotImplementedError
 
 
@@ -161,3 +167,7 @@ class LocalClient(ProtocolClient):
     async def private_rand(self, peer, request: bytes) -> bytes:
         svc = self._net._target(self._addr, peer)
         return await svc.private_rand(self._addr, request)
+
+    async def peer_metrics(self, peer) -> bytes:
+        svc = self._net._target(self._addr, peer)
+        return await svc.peer_metrics(self._addr)

@@ -103,6 +103,14 @@ class BatchedEngine:
             wire_prep = os.environ.get("DRAND_TPU_WIRE_PREP", "0") == "1"
         self.wire_prep = wire_prep
         self._verify_wire = jax.jit(self._wire_graph)
+        # Known-answer validation per bucket: the axon TPU stack's libtpu
+        # version skew produces silently-wrong executables at graph- and
+        # shape-dependent thresholds (correct at one batch size, all-wrong
+        # at another, moving between graph revisions). Every bucket is
+        # self-checked on first use; failing buckets are disabled and
+        # batches re-chunk to the largest PROVEN bucket.
+        self._bucket_ok: dict[int, bool] = {}
+        self._wire_ok: dict[int, bool] = {}
 
     @staticmethod
     def _wire_graph(pub_aff, sig_x, sig_sign, u_pairs):
@@ -133,19 +141,70 @@ class BatchedEngine:
         return got
 
     # ------------------------------------------------------------ verify
+    # -------------------------------------------------- bucket validation
+    def _known_answer_triples(self):
+        from ..crypto import bls
+
+        sk = 0x5A17
+        pub = PointG1.generator().mul(sk)
+        m_ok, m_bad = b"engine-bucket-check-ok", b"engine-bucket-check-bad"
+        sig_ok = PointG2.from_bytes(bls.sign(sk, m_ok), subgroup_check=False)
+        return [(pub, sig_ok, self._hash_msg(m_ok, DEFAULT_DST_G2)),
+                (pub, sig_ok, self._hash_msg(m_bad, DEFAULT_DST_G2))]
+
+    def _check_bucket(self, b: int) -> bool:
+        ok = self._bucket_ok.get(b)
+        if ok is not None:
+            return ok
+        triples = self._known_answer_triples()
+        if b == 1:  # one row per call
+            out = np.concatenate([self._run_bucket(triples[:1], 1),
+                                  self._run_bucket(triples[1:], 1)])
+        else:
+            out = self._run_bucket(triples, b)
+        ok = bool(out[0]) and not bool(out[1])
+        self._bucket_ok[b] = ok
+        if not ok:
+            from ..utils.logging import default_logger
+
+            default_logger("engine").warn(
+                "engine", "bucket_disabled", bucket=b,
+                reason="known-answer test failed (backend miscompile)")
+        return ok
+
+    def _good_bucket(self, n: int, check=None) -> int | None:
+        """Smallest validated bucket >= n, else the largest validated one
+        (the caller chunks), else None (no trustworthy bucket)."""
+        check = check or self._check_bucket
+        for b in self.buckets:
+            if b >= n and check(b):
+                return b
+        for b in reversed(self.buckets):
+            if check(b):
+                return b
+        return None
+
     def verify_bls(self, triples) -> np.ndarray:
         """Batch-verify BLS triples ``(pub: PointG1, sig: PointG2|None,
         msg_point: PointG2)``; a None signature marks an entry already known
         invalid (failed decode). Returns a bool array of len(triples).
-        Batches beyond the largest bucket run as multiple device calls."""
+        Batches beyond the largest validated bucket run as multiple device
+        calls; with no validated bucket the engine raises (auto mode falls
+        back to the host path)."""
         n = len(triples)
         if n == 0:
             return np.zeros(0, dtype=bool)
-        top = self.buckets[-1]
-        if n > top:
-            return np.concatenate([self.verify_bls(triples[i:i + top])
-                                   for i in range(0, n, top)])
-        b = _bucket(n, self.buckets)
+        b = self._good_bucket(n)
+        if b is None:
+            raise RuntimeError(
+                "device engine: no bucket passed known-answer validation")
+        if n > b:
+            return np.concatenate([self.verify_bls(triples[i:i + b])
+                                   for i in range(0, n, b)])
+        return self._run_bucket(triples, b)[:n]
+
+    def _run_bucket(self, triples, b: int) -> np.ndarray:
+        n = len(triples)
         pubs = np.zeros((b, 2, limb.NLIMBS), np.int32)
         sigs = np.zeros((b, 2, 2, limb.NLIMBS), np.int32)
         msgs = np.zeros((b, 2, 2, limb.NLIMBS), np.int32)
@@ -199,22 +258,54 @@ class BatchedEngine:
         flat = self.verify_bls(triples)
         return np.array([bool(flat[s:s + c].all()) for s, c in spans])
 
+    def _check_wire_bucket(self, b: int) -> bool:
+        ok = self._wire_ok.get(b)
+        if ok is not None:
+            return ok
+        from ..crypto import bls
+
+        sk = 0x5A17
+        pub = PointG1.generator().mul(sk)
+        m = b"engine-wire-bucket-check"
+        checks = [(m, bls.sign(sk, m)), (b"other-msg", bls.sign(sk, m))]
+        if b == 1:  # one row per call (same split as _check_bucket)
+            out = np.concatenate([self._run_wire_bucket(pub, checks[:1], 1),
+                                  self._run_wire_bucket(pub, checks[1:], 1)])
+        else:
+            out = self._run_wire_bucket(pub, checks, b)
+        ok = bool(out[0]) and not bool(out[1])
+        self._wire_ok[b] = ok
+        if not ok:
+            from ..utils.logging import default_logger
+
+            default_logger("engine").warn(
+                "engine", "wire_bucket_disabled", bucket=b)
+        return ok
+
     def verify_wire(self, pubkey: PointG1, checks,
                     dst: bytes = DEFAULT_DST_G2) -> np.ndarray:
         """Batch-verify (message bytes, compressed signature) pairs with
         DEVICE-side hashing/decompression/subgroup checks (ops/h2c.py):
-        host work is only SHA-256 expansion and byte unpacking."""
-        from . import h2c
-
+        host work is only SHA-256 expansion and byte unpacking. Buckets are
+        known-answer-validated like verify_bls's."""
         n = len(checks)
         if n == 0:
             return np.zeros(0, dtype=bool)
-        top = self.buckets[-1]
-        if n > top:
-            return np.concatenate([self.verify_wire(pubkey, checks[i:i + top],
+        b = self._good_bucket(n, check=self._check_wire_bucket)
+        if b is None:
+            raise RuntimeError(
+                "device engine: no wire bucket passed validation")
+        if n > b:
+            return np.concatenate([self.verify_wire(pubkey, checks[i:i + b],
                                                     dst)
-                                   for i in range(0, n, top)])
-        b = _bucket(n, self.buckets)
+                                   for i in range(0, n, b)])
+        return self._run_wire_bucket(pubkey, checks, b, dst)
+
+    def _run_wire_bucket(self, pubkey: PointG1, checks, b: int,
+                         dst: bytes = DEFAULT_DST_G2) -> np.ndarray:
+        from . import h2c
+
+        n = len(checks)
         pad_msg = b"drand-tpu-pad"
         msgs = [m for m, _ in checks] + [pad_msg] * (b - n)
         u = h2c.msgs_to_u(msgs, dst)

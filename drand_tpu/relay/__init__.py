@@ -1,0 +1,6 @@
+"""Beacon distribution relays.
+
+Reference: cmd/relay (HTTP CDN relay — covered by `drand_tpu.cli relay`),
+lp2p/ (gossipsub relay + validating client — `gossip.py` here, over a
+flood-pubsub gRPC mesh instead of libp2p, which this image lacks).
+"""

@@ -1,0 +1,190 @@
+"""Gossip distribution: flood-pubsub beacon relay with validation.
+
+Reference: lp2p/ — a relay node watches a source and republishes beacons
+on a pubsub topic (relaynode.go:48); subscribers VALIDATE before accepting
+or re-forwarding (client/validator.go:16-69 rejects future rounds and bad
+signatures so invalid data never propagates). libp2p is not in this image,
+so the mesh is explicit peers over a grpc.aio "drand.Gossip" service with
+hash dedup — the same flood/validate semantics on a static topology.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+
+import grpc
+import grpc.aio
+
+from ..chain import beacon as chain_beacon
+from ..chain import time_math
+from ..chain.beacon import Beacon
+from ..chain.info import Info
+from ..client.interface import Client, ClientError, result_from_beacon
+from ..net import wire
+from ..utils.clock import Clock, SystemClock
+from ..utils.logging import KVLogger, default_logger
+
+SERVICE = "drand.Gossip"
+
+
+class GossipNode(Client):
+    """One pubsub participant: subscribe/publish beacons for one chain.
+
+    - `serve(listen)` starts the ingress port.
+    - `add_peer(addr)` joins a static mesh (both directions flood).
+    - `publish(beacon)` injects locally (the relay side feeds this from a
+      watched client source).
+    - Client surface: `watch()` yields validated incoming beacons; `get`
+    returns the best-seen tip (relays keep a window, not the full chain).
+    """
+
+    def __init__(self, info: Info, clock: Clock | None = None,
+                 logger: KVLogger | None = None, cache_rounds: int = 128):
+        self.chain_info = info
+        self._clock = clock or SystemClock()
+        self._l = logger or default_logger("gossip")
+        self._peers: dict[str, grpc.aio.Channel] = {}
+        self._seen: dict[bytes, None] = {}  # insertion-ordered for FIFO evict
+        self._cache: dict[int, Beacon] = {}
+        self._cache_rounds = cache_rounds
+        self._tip = 0
+        self._subs: list[asyncio.Queue] = []
+        self._server: grpc.aio.Server | None = None
+        self.port: int | None = None
+
+    # ------------------------------------------------------------- mesh
+    async def serve(self, listen: str) -> None:
+        server = grpc.aio.server()
+        handlers = {"Publish": grpc.unary_unary_rpc_method_handler(
+            self._handle_publish)}
+        server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+        self.port = server.add_insecure_port(listen)
+        await server.start()
+        self._server = server
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(0.2)
+        for ch in self._peers.values():
+            await ch.close()
+
+    def add_peer(self, addr: str) -> None:
+        if addr not in self._peers:
+            self._peers[addr] = grpc.aio.insecure_channel(addr)
+
+    # ---------------------------------------------------------- validation
+    def _validate(self, b: Beacon) -> bool:
+        """lp2p/client/validator.go:16-69: reject far-future rounds and
+        invalid signatures BEFORE caching or re-flooding."""
+        current = time_math.current_round(int(self._clock.now()),
+                                          self.chain_info.period,
+                                          self.chain_info.genesis_time)
+        if b.round > current + 1:
+            return False
+        ok = chain_beacon.verify_beacon(self.chain_info.public_key, b)
+        if ok and b.is_v2():
+            ok = chain_beacon.verify_beacon_v2(self.chain_info.public_key, b)
+        return ok
+
+    # ------------------------------------------------------------- pubsub
+    async def publish(self, b: Beacon) -> None:
+        await self._accept(wire.encode(b), validate=True)
+
+    async def _handle_publish(self, request: bytes, context) -> bytes:
+        try:
+            await self._accept(request, validate=True)
+        except wire.WireError as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return b"{}"
+
+    async def _accept(self, raw: bytes, validate: bool) -> None:
+        msg_id = hashlib.blake2b(raw, digest_size=16).digest()
+        if msg_id in self._seen:
+            return
+        msg, _ = wire.decode(raw)
+        if not isinstance(msg, Beacon):
+            raise wire.WireError("gossip: not a beacon")
+        if validate and not self._validate(msg):
+            # do NOT record rejected messages as seen: a beacon dropped for
+            # clock skew must be acceptable when it arrives again later
+            self._l.warn("gossip", "invalid_beacon_dropped", round=msg.round)
+            return
+        self._seen[msg_id] = None
+        while len(self._seen) > 4096:  # FIFO eviction (oldest first)
+            self._seen.pop(next(iter(self._seen)))
+        self._cache[msg.round] = msg
+        self._tip = max(self._tip, msg.round)
+        for r in list(self._cache):
+            if r < self._tip - self._cache_rounds:
+                del self._cache[r]
+        for q in list(self._subs):
+            try:
+                q.put_nowait(msg)
+            except asyncio.QueueFull:
+                pass
+        for addr, ch in self._peers.items():
+            asyncio.ensure_future(self._forward(addr, ch, raw))
+
+    async def _forward(self, addr: str, ch: grpc.aio.Channel,
+                       raw: bytes) -> None:
+        try:
+            await ch.unary_unary(f"/{SERVICE}/Publish")(raw, timeout=5.0)
+        except grpc.aio.AioRpcError as e:
+            self._l.debug("gossip", "forward_failed", to=addr,
+                          code=e.code().name)
+
+    # ------------------------------------------------------------- Client
+    async def get(self, round_no: int = 0):
+        b = self._cache.get(round_no or self._tip)
+        if b is None:
+            raise ClientError(f"gossip: round {round_no or self._tip} "
+                              f"not in window")
+        return result_from_beacon(b)
+
+    async def watch(self):
+        q: asyncio.Queue = asyncio.Queue(maxsize=32)
+        self._subs.append(q)
+        try:
+            while True:
+                yield result_from_beacon(await q.get())
+        finally:
+            self._subs.remove(q)
+
+    async def info(self) -> Info:  # Client surface
+        return self.chain_info
+
+    def round_at(self, t: float) -> int:
+        return time_math.current_round(int(t), self.chain_info.period,
+                                       self.chain_info.genesis_time)
+
+
+class GossipRelay:
+    """Relay: watch a client source, publish every beacon into the mesh
+    (lp2p/relaynode.go:48)."""
+
+    def __init__(self, source: Client, node: GossipNode):
+        self._src = source
+        self.node = node
+        self._task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        self._task = asyncio.ensure_future(self._run())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                async for r in self._src.watch():
+                    await self.node.publish(Beacon(
+                        round=r.round, previous_sig=r.previous_signature,
+                        signature=r.signature,
+                        signature_v2=r.signature_v2))
+            except asyncio.CancelledError:
+                return
+            except Exception:  # noqa: BLE001 — keep relaying
+                await asyncio.sleep(1.0)
