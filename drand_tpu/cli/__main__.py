@@ -52,9 +52,7 @@ def cmd_generate_keypair(args) -> None:
     if store.has_key_pair() and not args.force:
         raise SystemExit(f"keypair already exists in {store.key_folder} "
                          f"(--force to overwrite)")
-    # tls=False until the secure transport lands; the identity flag must
-    # match what the gateway actually serves
-    pair = new_key_pair(args.address, tls=False)
+    pair = new_key_pair(args.address, tls=args.tls)
     store.save_key_pair(pair)
     print(json.dumps({
         "address": args.address,
@@ -85,9 +83,29 @@ async def _run_daemon(args) -> None:
                   dkg_timeout=args.dkg_timeout)
     d = Drand.load(ks, conf, None, logger)
     priv_addr = args.private_listen or d.priv.public.addr
-    client = GrpcClient(own_addr=d.priv.public.addr)
+    tls_pair = None
+    certs = None
+    if args.tls:
+        from ..net import tls as tls_mod
+
+        tls_dir = os.path.join(folder, "tls")
+        cert_path = os.path.join(tls_dir, "cert.pem")
+        if not os.path.isfile(cert_path):
+            cert_path, _ = tls_mod.generate_self_signed(
+                d.priv.public.addr, tls_dir)
+            print(f"generated TLS cert {cert_path} — distribute it to "
+                  f"peers' tls/trusted/ folders", flush=True)
+        tls_pair = (cert_path, os.path.join(tls_dir, "key.pem"))
+        certs = tls_mod.CertManager()
+        certs.add(cert_path)  # trust ourselves (loopback partials)
+        trusted = os.path.join(tls_dir, "trusted")
+        if os.path.isdir(trusted):
+            for name in sorted(os.listdir(trusted)):
+                if name.endswith(".pem"):
+                    certs.add(os.path.join(trusted, name))
+    client = GrpcClient(own_addr=d.priv.public.addr, certs=certs)
     d.client = client
-    gateway = GrpcGateway(d, priv_addr, logger.named("gw"))
+    gateway = GrpcGateway(d, priv_addr, logger.named("gw"), tls=tls_pair)
     await gateway.start()
     control = ControlServer(d, args.control, logger.named("ctl"))
     await control.start()
@@ -126,7 +144,8 @@ async def _serve_public(d, listen: str, logger) -> None:
         return await d.client.peer_metrics(addr)
 
     server = PublicServer(DirectClient(d.beacon), logger=logger.named("http"),
-                          peer_metrics_fn=peer_metrics)
+                          peer_metrics_fn=peer_metrics,
+                          enable_pprof=os.environ.get("DRAND_TPU_PPROF") == "1")
     await server.start(host or "0.0.0.0", int(port))
     logger.info("http", "serving", listen=listen)
     await asyncio.Event().wait()
@@ -340,6 +359,8 @@ def main(argv=None) -> None:
     g = sub.add_parser("generate-keypair")
     g.add_argument("address")
     g.add_argument("--folder")
+    g.add_argument("--tls", action="store_true",
+                   help="mark the identity as TLS-served (start --tls)")
     g.add_argument("--force", action="store_true")
     g.set_defaults(fn=cmd_generate_keypair)
 
@@ -349,6 +370,10 @@ def main(argv=None) -> None:
     s.add_argument("--public-listen")
     s.add_argument("--control", type=int, default=8888)
     s.add_argument("--dkg-timeout", type=float, default=10.0)
+    s.add_argument("--tls", action="store_true",
+                   help="serve the node port over TLS (self-signed cert "
+                        "under <folder>/tls/; peers' certs go in "
+                        "<folder>/tls/trusted/*.pem)")
     s.add_argument("--verbose", action="store_true")
     s.set_defaults(fn=cmd_start)
 

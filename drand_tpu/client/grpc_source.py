@@ -1,0 +1,54 @@
+"""gRPC client source: consumes a node's Public service directly.
+
+Reference: client/grpc/client.go (New :30, Watch :82 server-streaming).
+Wraps the node transport's PublicRand/PublicRandStream/ChainInfo into the
+client.Client surface, so the verified stack can sit on raw gRPC instead
+of (or racing against) HTTP.
+"""
+
+from __future__ import annotations
+
+from ..chain import time_math
+from ..chain.info import Info
+from ..net.grpc_transport import GrpcClient
+from ..net.transport import TransportError
+from .interface import Client, ClientError, result_from_beacon
+
+
+class GrpcSource(Client):
+    def __init__(self, address: str, own_addr: str = "client", certs=None):
+        self._addr = address
+        # certs: a net.tls.CertManager to trust a TLS-serving node
+        self._client = GrpcClient(own_addr=own_addr, certs=certs)
+        self._info: Info | None = None
+
+    async def get(self, round_no: int = 0):
+        try:
+            b = await self._client.public_rand(self._addr, round_no)
+        except TransportError as e:
+            raise ClientError(str(e)) from e
+        return result_from_beacon(b)
+
+    async def watch(self):
+        try:
+            async for b in self._client.public_rand_stream(self._addr):
+                yield result_from_beacon(b)
+        except TransportError as e:
+            raise ClientError(str(e)) from e
+
+    async def info(self) -> Info:
+        if self._info is None:
+            try:
+                self._info = await self._client.chain_info(self._addr)
+            except TransportError as e:
+                raise ClientError(str(e)) from e
+        return self._info
+
+    def round_at(self, t: float) -> int:
+        if self._info is None:
+            raise ClientError("info not fetched yet")
+        return time_math.current_round(int(t), self._info.period,
+                                       self._info.genesis_time)
+
+    async def close(self) -> None:
+        await self._client.close()

@@ -392,6 +392,38 @@ class Drand(ProtocolService):
     async def get_identity(self, from_addr: str):
         return self.priv.public
 
+    async def public_rand(self, from_addr: str, round_no: int):
+        """Public randomness over gRPC (core/drand_public.go:52): round 0
+        means latest; raises while the chain is empty."""
+        from ..chain.store import StoreError
+
+        if self.beacon is None:
+            raise TransportError("no beacon running")
+        store = self.beacon.chain
+        try:
+            b = store.last() if round_no == 0 else store.get(round_no)
+        except StoreError as e:
+            raise TransportError(f"chain empty: {e}") from e
+        if b is None or b.round == 0:
+            raise TransportError(f"no beacon for round {round_no}")
+        return b
+
+    async def public_rand_stream(self, from_addr: str):
+        """Server-streaming watch (core/drand_public.go:76): every new
+        beacon from now on."""
+        if self.beacon is None:
+            raise TransportError("no beacon running")
+        queue: asyncio.Queue = asyncio.Queue(maxsize=32)
+        cb_id = f"public-stream-{from_addr}-{id(queue)}"
+        self.beacon.chain.add_callback(
+            cb_id, lambda b: queue.put_nowait(b)
+            if not queue.full() else None)
+        try:
+            while True:
+                yield await queue.get()
+        finally:
+            self.beacon.chain.remove_callback(cb_id)
+
     async def peer_metrics(self, from_addr: str) -> bytes:
         """Serve our prometheus metrics to group members over the node
         transport (core/drand_metrics.go:12 PeerMetrics)."""

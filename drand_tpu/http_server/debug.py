@@ -1,0 +1,87 @@
+"""Opt-in debug/profiling endpoints.
+
+Reference: metrics/pprof/pprof.go:13-24 (profile/symbol/trace mux, opt-in
+via WithProfile) and the /debug/gc handler (metrics/metrics.go:256). The
+Python analogues: cProfile for CPU profiles, per-thread stack dumps, gc
+stats, and — when jax is loaded — the JAX profiler for device traces.
+
+    GET /debug/pprof/profile?seconds=5   cProfile over the window (text)
+    GET /debug/pprof/stacks              every thread's current stack
+    GET /debug/gc                        run a collection, report counts
+    GET /debug/jax/trace?seconds=2       JAX device trace -> path on disk
+"""
+
+from __future__ import annotations
+
+import asyncio
+import cProfile
+import gc
+import io
+import pstats
+import sys
+import tempfile
+import traceback
+
+from aiohttp import web
+
+
+def add_debug_routes(app: web.Application) -> None:
+    app.add_routes([
+        web.get("/debug/pprof/profile", _profile),
+        web.get("/debug/pprof/stacks", _stacks),
+        web.get("/debug/gc", _gc),
+        web.get("/debug/jax/trace", _jax_trace),
+    ])
+
+
+_PROFILE_LOCK = asyncio.Lock()  # cProfile and the JAX tracer cannot nest
+
+
+async def _profile(request: web.Request) -> web.Response:
+    if _PROFILE_LOCK.locked():
+        return web.json_response({"error": "a profile is already running"},
+                                 status=409)
+    async with _PROFILE_LOCK:
+        seconds = min(float(request.query.get("seconds", "5")), 60.0)
+        prof = cProfile.Profile()
+        prof.enable()
+        await asyncio.sleep(seconds)
+        prof.disable()
+    buf = io.StringIO()
+    pstats.Stats(prof, stream=buf).sort_stats("cumulative").print_stats(50)
+    return web.Response(text=buf.getvalue(), content_type="text/plain")
+
+
+async def _stacks(request: web.Request) -> web.Response:
+    out = []
+    for tid, frame in sys._current_frames().items():
+        out.append(f"--- thread {tid} ---")
+        out.extend(traceback.format_stack(frame))
+    return web.Response(text="\n".join(out), content_type="text/plain")
+
+
+async def _gc(request: web.Request) -> web.Response:
+    collected = gc.collect()
+    return web.json_response({
+        "collected": collected,
+        "counts": gc.get_count(),
+        "tracked": len(gc.get_objects()),
+    })
+
+
+async def _jax_trace(request: web.Request) -> web.Response:
+    if "jax" not in sys.modules:
+        return web.json_response({"error": "jax not loaded in this process"},
+                                 status=404)
+    import jax
+
+    if _PROFILE_LOCK.locked():
+        return web.json_response({"error": "a profile is already running"},
+                                 status=409)
+    async with _PROFILE_LOCK:
+        seconds = min(float(request.query.get("seconds", "2")), 30.0)
+        out_dir = tempfile.mkdtemp(prefix="drand-tpu-jaxtrace-")
+        jax.profiler.start_trace(out_dir)
+        await asyncio.sleep(seconds)
+        jax.profiler.stop_trace()
+    return web.json_response({"trace_dir": out_dir, "seconds": seconds})

@@ -45,7 +45,8 @@ class PublicServer:
     def __init__(self, client: Client, clock: Clock | None = None,
                  logger: KVLogger | None = None,
                  watch_timeout: float = 30.0,
-                 peer_metrics_fn=None):
+                 peer_metrics_fn=None,
+                 enable_pprof: bool = False):
         self._client = client
         self._clock = clock or SystemClock()
         self._l = logger or default_logger("http")
@@ -65,6 +66,10 @@ class PublicServer:
             web.get("/metrics", self._handle_metrics),
             web.get("/peer/{addr}/metrics", self._handle_peer_metrics),
         ])
+        if enable_pprof:  # opt-in like the reference (pprof.go WithProfile)
+            from .debug import add_debug_routes
+
+            add_debug_routes(self.app)
 
     # ------------------------------------------------------------ serving
     async def start(self, host: str, port: int) -> web.TCPSite:

@@ -27,20 +27,24 @@ from .transport import ProtocolClient, ProtocolService, TransportError
 SERVICE = "drand.Protocol"
 _UNARY = ("GetIdentity", "SignalDKGParticipant", "PushDKGInfo",
           "BroadcastDKG", "PartialBeacon", "ChainInfo", "PrivateRand",
-          "Metrics")
+          "Metrics", "PublicRand")
 
 DEFAULT_TIMEOUT = 5.0
 SYNC_TIMEOUT = 600.0
 
 
 class GrpcGateway:
-    """Server side: exposes a ProtocolService on a TCP port."""
+    """Server side: exposes a ProtocolService on a TCP port; with
+    ``tls=(cert_path, key_path)`` the listener speaks TLS
+    (net/listener.go:108)."""
 
     def __init__(self, service: ProtocolService, listen: str,
-                 logger: KVLogger | None = None):
+                 logger: KVLogger | None = None,
+                 tls: tuple[str, str] | None = None):
         self._svc = service
         self._listen = listen
         self._l = logger or default_logger("grpc")
+        self._tls = tls
         self._server: grpc.aio.Server | None = None
         self.port: int | None = None
 
@@ -52,14 +56,23 @@ class GrpcGateway:
                 self._unary(name))
         handlers["SyncChain"] = grpc.unary_stream_rpc_method_handler(
             self._sync_chain)
+        handlers["PublicRandStream"] = grpc.unary_stream_rpc_method_handler(
+            self._public_rand_stream)
         server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(SERVICE, handlers),))
-        self.port = server.add_insecure_port(self._listen)
+        if self._tls is not None:
+            from . import tls as tls_mod
+
+            creds = tls_mod.server_credentials(*self._tls)
+            self.port = server.add_secure_port(self._listen, creds)
+        else:
+            self.port = server.add_insecure_port(self._listen)
         if self.port == 0:
             raise TransportError(f"cannot bind {self._listen}")
         await server.start()
         self._server = server
-        self._l.info("grpc", "listening", addr=self._listen, port=self.port)
+        self._l.info("grpc", "listening", addr=self._listen, port=self.port,
+                     tls=self._tls is not None)
 
     async def stop(self, grace: float = 0.5) -> None:
         if self._server is not None:
@@ -76,6 +89,7 @@ class GrpcGateway:
             "ChainInfo": self._chain_info,
             "PrivateRand": self._private_rand,
             "Metrics": self._peer_metrics,
+            "PublicRand": self._public_rand,
         }[name]
 
         async def handler(request: bytes, context) -> bytes:
@@ -120,6 +134,23 @@ class GrpcGateway:
     async def _peer_metrics(self, msg, from_addr) -> bytes:
         return wire.encode(wire.Blob(await self._svc.peer_metrics(from_addr)))
 
+    async def _public_rand(self, msg, from_addr) -> bytes:
+        # request reuses SyncRequest: from_round = wanted round (0 = latest)
+        b = await self._svc.public_rand(from_addr, msg.from_round)
+        return wire.encode(b)
+
+    async def _public_rand_stream(self, request: bytes, context):
+        try:
+            _, from_addr = wire.decode(request)
+        except wire.WireError as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            return
+        try:
+            async for b in self._svc.public_rand_stream(from_addr):
+                yield wire.encode(b)
+        except TransportError as e:
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+
     async def _sync_chain(self, request: bytes, context):
         try:
             msg, from_addr = wire.decode(request)
@@ -137,18 +168,33 @@ class GrpcClient(ProtocolClient):
     """Outbound calls with a per-peer channel pool (client_grpc.go:271)."""
 
     def __init__(self, own_addr: str, timeout: float = DEFAULT_TIMEOUT,
-                 logger: KVLogger | None = None):
+                 logger: KVLogger | None = None, certs=None):
         self._addr = own_addr
         self._timeout = timeout
         self._l = logger or default_logger("grpc.client")
+        # certs: a tls.CertManager. A peer is dialed over TLS when the pool
+        # is non-empty AND the peer's Identity.tls flag allows it (plain
+        # addresses default to TLS when a pool exists) — mixed groups keep
+        # plaintext members reachable (net/certs.go + client_grpc.go)
+        self._certs = certs
         self._channels: dict[str, grpc.aio.Channel] = {}
 
     def _channel(self, peer) -> tuple[grpc.aio.Channel, str]:
         target = peer.address() if hasattr(peer, "address") else str(peer)
-        ch = self._channels.get(target)
+        have_pool = self._certs is not None and \
+            self._certs.pool_pem() is not None
+        use_tls = have_pool and getattr(peer, "tls", True)
+        key = ("tls" if use_tls else "plain", target)
+        ch = self._channels.get(key)
         if ch is None:
-            ch = grpc.aio.insecure_channel(target)
-            self._channels[target] = ch
+            if use_tls:
+                from . import tls as tls_mod
+
+                ch = grpc.aio.secure_channel(
+                    target, tls_mod.channel_credentials(self._certs))
+            else:
+                ch = grpc.aio.insecure_channel(target)
+            self._channels[key] = ch
         return ch, target
 
     async def close(self) -> None:
@@ -214,6 +260,26 @@ class GrpcClient(ProtocolClient):
         raw = await self._call(peer, "Metrics", b_empty())
         msg, _ = wire.decode(raw)
         return bytes(msg)
+
+    async def public_rand(self, peer, round_no: int):
+        raw = await self._call(peer, "PublicRand",
+                               SyncRequest(from_round=round_no))
+        msg, _ = wire.decode(raw)
+        return msg
+
+    async def public_rand_stream(self, peer):
+        ch, target = self._channel(peer)
+        fn = ch.unary_stream(f"/{SERVICE}/PublicRandStream")
+        # no deadline: a watch stream is indefinite (client/grpc Watch)
+        call = fn(wire.encode(b_empty(), from_addr=self._addr), timeout=None)
+        try:
+            async for raw in call:
+                msg, _ = wire.decode(raw)
+                yield msg
+        except grpc.aio.AioRpcError as e:
+            raise TransportError(
+                f"{target} PublicRandStream: {e.code().name} "
+                f"{e.details()}") from e
 
 
 def b_empty():

@@ -1,0 +1,95 @@
+"""TLS for the node<->node plane.
+
+Reference: net/listener.go:108-146 (hardened TLS listener) and net/certs.go
+(CertManager :14 — a pool of manually-trusted certificates for self-signed
+deployments, the common drand setup). Certificates are generated with the
+`cryptography` package; the gRPC layer consumes PEM bytes.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+
+from ..utils import fs
+
+
+def generate_self_signed(address: str, folder: str,
+                         days: int = 3650) -> tuple[str, str]:
+    """Create key.pem + cert.pem for `address` (host:port) under `folder`
+    with the right SAN (IP or DNS). Returns (cert_path, key_path)."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    host = address.rsplit(":", 1)[0]
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, host)])
+    try:
+        san = x509.SubjectAlternativeName(
+            [x509.IPAddress(ipaddress.ip_address(host))])
+    except ValueError:
+        san = x509.SubjectAlternativeName([x509.DNSName(host)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=days))
+        .add_extension(san, critical=False)
+        .sign(key, hashes.SHA256())
+    )
+    fs.create_secure_folder(folder)
+    key_path = os.path.join(folder, "key.pem")
+    cert_path = os.path.join(folder, "cert.pem")
+    fs.write_secure_file(key_path, key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption()))
+    with open(cert_path, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    return cert_path, key_path
+
+
+class CertManager:
+    """Pool of manually-trusted peer certificates (net/certs.go:14):
+    self-signed deployments exchange cert files out of band and `add` them
+    here; the pool becomes the client-side root set."""
+
+    def __init__(self):
+        self._pems: list[bytes] = []
+
+    def add(self, cert_path: str) -> None:
+        with open(cert_path, "rb") as f:
+            self._pems.append(f.read())
+
+    def add_pem(self, pem: bytes) -> None:
+        self._pems.append(pem)
+
+    def pool_pem(self) -> bytes | None:
+        if not self._pems:
+            return None
+        return b"".join(self._pems)
+
+
+def server_credentials(cert_path: str, key_path: str):
+    import grpc
+
+    with open(key_path, "rb") as f:
+        key = f.read()
+    with open(cert_path, "rb") as f:
+        cert = f.read()
+    return grpc.ssl_server_credentials([(key, cert)])
+
+
+def channel_credentials(certs: CertManager | None):
+    """Client side: trust the managed pool (None = system roots)."""
+    import grpc
+
+    return grpc.ssl_channel_credentials(
+        root_certificates=certs.pool_pem() if certs else None)

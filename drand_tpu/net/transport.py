@@ -56,6 +56,12 @@ class ProtocolClient:
     async def peer_metrics(self, peer) -> bytes:
         raise NotImplementedError
 
+    async def public_rand(self, peer, round_no: int) -> "Beacon":
+        raise NotImplementedError
+
+    def public_rand_stream(self, peer) -> "AsyncIterator[Beacon]":
+        raise NotImplementedError
+
 
 class ProtocolService:
     """Inbound service surface a node registers on its transport
@@ -86,6 +92,12 @@ class ProtocolService:
         raise NotImplementedError
 
     async def peer_metrics(self, from_addr: str) -> bytes:
+        raise NotImplementedError
+
+    async def public_rand(self, from_addr: str, round_no: int) -> "Beacon":
+        raise NotImplementedError
+
+    def public_rand_stream(self, from_addr: str) -> "AsyncIterator[Beacon]":
         raise NotImplementedError
 
 
@@ -171,3 +183,12 @@ class LocalClient(ProtocolClient):
     async def peer_metrics(self, peer) -> bytes:
         svc = self._net._target(self._addr, peer)
         return await svc.peer_metrics(self._addr)
+
+    async def public_rand(self, peer, round_no: int):
+        svc = self._net._target(self._addr, peer)
+        return await svc.public_rand(self._addr, round_no)
+
+    async def public_rand_stream(self, peer):
+        svc = self._net._target(self._addr, peer)
+        async for b in svc.public_rand_stream(self._addr):
+            yield b

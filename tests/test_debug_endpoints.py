@@ -1,0 +1,44 @@
+"""Opt-in debug/profiling endpoints (metrics/pprof/pprof.go analogue)."""
+
+import aiohttp
+import pytest
+
+from drand_tpu.client.direct import DirectClient
+from drand_tpu.http_server.server import PublicServer
+from drand_tpu.testing.harness import BeaconTestNetwork
+
+
+@pytest.mark.asyncio
+async def test_debug_routes_opt_in():
+    net = BeaconTestNetwork(n=3, t=2, period=5)
+    await net.start_all()
+    await net.advance_to_genesis()
+    await net.clock.advance(5)
+    await net.wait_round(0, 1)
+    on = PublicServer(DirectClient(net.nodes[0].handler), clock=net.clock,
+                      enable_pprof=True)
+    off = PublicServer(DirectClient(net.nodes[0].handler), clock=net.clock)
+    site_on = await on.start("127.0.0.1", 0)
+    site_off = await off.start("127.0.0.1", 0)
+    p_on = site_on._server.sockets[0].getsockname()[1]
+    p_off = site_off._server.sockets[0].getsockname()[1]
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{p_on}/debug/gc") as r:
+                assert r.status == 200
+                assert "collected" in await r.json()
+            async with s.get(f"http://127.0.0.1:{p_on}"
+                             f"/debug/pprof/stacks") as r:
+                assert r.status == 200
+                assert "thread" in await r.text()
+            async with s.get(f"http://127.0.0.1:{p_on}"
+                             f"/debug/pprof/profile?seconds=0.2") as r:
+                assert r.status == 200
+                assert "cumulative" in await r.text()
+            # debug surface is OFF by default
+            async with s.get(f"http://127.0.0.1:{p_off}/debug/gc") as r:
+                assert r.status == 404
+    finally:
+        await on.stop()
+        await off.stop()
+        net.stop_all()
