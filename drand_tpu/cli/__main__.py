@@ -340,14 +340,141 @@ def cmd_relay(args) -> None:
         from ..http_server.server import PublicServer
 
         sources = [HTTPClient(u) for u in args.url.split(",")]
-        chain_hash = bytes.fromhex(args.chain_hash) if args.chain_hash else b""
-        client = new_client(sources, chain_hash=chain_hash,
-                            insecurely=not chain_hash)
+        client = new_client(sources, **_client_trust(args))
         server = PublicServer(client)
         host, port = args.listen.rsplit(":", 1)
         await server.start(host or "0.0.0.0", int(port))
         print(f"relay serving {args.listen} from {args.url}", flush=True)
         await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+def _client_trust(args) -> dict:
+    """Trust-root kwargs for new_client: a pinned chain hash, or an
+    EXPLICIT --insecure opt-out (the reference CLI likewise refuses to
+    fetch unverified randomness by default)."""
+    if args.chain_hash:
+        return {"chain_hash": bytes.fromhex(args.chain_hash)}
+    if getattr(args, "insecure", False):
+        return {"insecurely": True}
+    raise SystemExit(
+        "--chain-hash is required (or pass --insecure to skip verification)")
+
+
+def cmd_client(args) -> None:
+    """Standalone randomness consumer (reference cmd/client/lib/cli.go:97
+    Create): build the full verified stack over HTTP and/or gRPC sources,
+    then one-shot get or watch, printing one JSON object per round."""
+
+    async def run():
+        from ..client import new_client
+        from ..client.http import HTTPClient
+        from ..client.grpc_source import GrpcSource
+
+        sources = []
+        if args.url:
+            sources += [HTTPClient(u) for u in args.url.split(",")]
+        if args.grpc:
+            sources += [GrpcSource(a) for a in args.grpc.split(",")]
+        if not sources:
+            raise SystemExit("need --url and/or --grpc sources")
+        from ..http_server.server import result_json
+
+        client = new_client(sources, **_client_trust(args))
+        try:
+            if args.watch:
+                async for r in client.watch():
+                    print(json.dumps(result_json(r)), flush=True)
+            else:
+                print(json.dumps(result_json(await client.get(args.round)),
+                                 indent=2))
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+
+
+def cmd_relay_archive(args) -> None:
+    """Archive relay (reference cmd/relay-s3): watch a chain and persist
+    every beacon as a JSON object laid out like the public REST API
+    (`<out>/public/<round>`, `<out>/info`), ready for static/CDN serving
+    or an `aws s3 sync`. `--sync` backfills history first
+    (relay-s3/main.go:142 historic sync)."""
+
+    async def run():
+        from ..client import new_client
+        from ..client.http import HTTPClient
+        from ..http_server.server import result_json
+
+        sources = [HTTPClient(u) for u in args.url.split(",")]
+        client = new_client(sources, **_client_trust(args))
+        pub = os.path.join(args.out, "public")
+        os.makedirs(pub, exist_ok=True)
+
+        def put(r) -> None:
+            path = os.path.join(pub, str(r.round))
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(result_json(r), f)
+            os.replace(tmp, path)
+
+        async def fetch_span(start: int, end: int, width: int = 16,
+                             attempts: int = 3) -> None:
+            # bounded-concurrency backfill: each get() is an independent
+            # verified fetch, so a small gather window cuts wall-clock.
+            # Rounds already on disk are skipped (restart-friendly);
+            # transient failures retry, persistent ones raise.
+            todo = [rd for rd in range(start, end + 1)
+                    if not os.path.exists(os.path.join(pub, str(rd)))]
+            for attempt in range(attempts):
+                failed = []
+                for lo in range(0, len(todo), width):
+                    rounds = todo[lo:lo + width]
+                    results = await asyncio.gather(
+                        *(client.get(rd) for rd in rounds),
+                        return_exceptions=True)
+                    for rd, r in zip(rounds, results):
+                        if isinstance(r, BaseException):
+                            failed.append(rd)
+                        else:
+                            put(r)
+                if not failed:
+                    return
+                todo = failed
+                await asyncio.sleep(1.0 * (attempt + 1))
+            raise SystemExit(f"backfill failed for rounds {todo[:10]}"
+                             f"{'...' if len(todo) > 10 else ''}")
+
+        archived = 0
+        try:
+            info = await client.info()
+            with open(os.path.join(args.out, "info"), "w") as f:
+                f.write(info.to_json())
+            if args.sync or args.once or args.sync_from:
+                latest = (await client.get(0)).round
+                archived = latest
+                await fetch_span(args.sync_from or 1, latest)
+                print(f"backfilled rounds {args.sync_from or 1}..{latest}",
+                      flush=True)
+            if args.once:
+                return
+            async for r in client.watch():
+                put(r)
+                print(f"archived round {r.round}", flush=True)
+                # heal any hole between the watermark and this round
+                # (rounds produced during backfill, watch hiccups); on
+                # failure keep the watermark so the NEXT beacon retries
+                # the heal (fetch_span skips rounds already on disk)
+                if archived and r.round > archived + 1:
+                    try:
+                        await fetch_span(archived + 1, r.round - 1)
+                    except SystemExit as e:
+                        print(f"gap heal deferred: {e}", flush=True)
+                        continue
+                archived = max(archived, r.round)
+        finally:
+            await client.close()
 
     asyncio.run(run())
 
@@ -428,7 +555,36 @@ def main(argv=None) -> None:
     r.add_argument("--listen", required=True)
     r.add_argument("--chain-hash", default="",
                    help="hex chain hash to pin (verifies all beacons)")
+    r.add_argument("--insecure", action="store_true",
+                   help="explicitly skip beacon verification")
     r.set_defaults(fn=cmd_relay)
+
+    c = sub.add_parser("client")
+    c.add_argument("--url", default="", help="comma-separated HTTP origins")
+    c.add_argument("--grpc", default="",
+                   help="comma-separated gRPC node addresses")
+    c.add_argument("--chain-hash", default="")
+    c.add_argument("--insecure", action="store_true",
+                   help="explicitly skip beacon verification")
+    c.add_argument("--round", type=int, default=0)
+    c.add_argument("--watch", action="store_true")
+    c.set_defaults(fn=cmd_client)
+
+    ra = sub.add_parser("relay-archive")
+    ra.add_argument("--url", required=True,
+                    help="comma-separated origin base URLs")
+    ra.add_argument("--out", required=True,
+                    help="output directory (S3-sync / CDN layout)")
+    ra.add_argument("--chain-hash", default="")
+    ra.add_argument("--insecure", action="store_true",
+                    help="explicitly skip beacon verification")
+    ra.add_argument("--sync", action="store_true",
+                    help="backfill history before watching")
+    ra.add_argument("--once", action="store_true",
+                    help="backfill then exit (relay-s3's `sync` command)")
+    ra.add_argument("--sync-from", type=int, default=0,
+                    help="first round to backfill (implies --sync)")
+    ra.set_defaults(fn=cmd_relay_archive)
 
     args = p.parse_args(argv)
     args.fn(args)
