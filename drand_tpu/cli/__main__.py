@@ -355,7 +355,11 @@ def _client_trust(args) -> dict:
     EXPLICIT --insecure opt-out (the reference CLI likewise refuses to
     fetch unverified randomness by default)."""
     if args.chain_hash:
-        return {"chain_hash": bytes.fromhex(args.chain_hash)}
+        try:
+            return {"chain_hash": bytes.fromhex(args.chain_hash)}
+        except ValueError:
+            raise SystemExit(f"--chain-hash is not valid hex: "
+                             f"{args.chain_hash!r}")
     if getattr(args, "insecure", False):
         return {"insecurely": True}
     raise SystemExit(
@@ -381,6 +385,8 @@ def cmd_client(args) -> None:
             raise SystemExit("need --url and/or --grpc sources")
         from ..http_server.server import result_json
 
+        if args.watch and args.round:
+            raise SystemExit("--round and --watch are mutually exclusive")
         client = new_client(sources, **_client_trust(args))
         try:
             if args.watch:
@@ -419,14 +425,20 @@ def cmd_relay_archive(args) -> None:
                 json.dump(result_json(r), f)
             os.replace(tmp, path)
 
+        given_up: set[int] = set()
+
         async def fetch_span(start: int, end: int, width: int = 16,
                              attempts: int = 3) -> None:
             # bounded-concurrency backfill: each get() is an independent
             # verified fetch, so a small gather window cuts wall-clock.
-            # Rounds already on disk are skipped (restart-friendly);
-            # transient failures retry, persistent ones raise.
+            # Rounds already on disk (or given up on) are skipped
+            # (restart-friendly); transient failures retry, persistent
+            # ones raise.
             todo = [rd for rd in range(start, end + 1)
-                    if not os.path.exists(os.path.join(pub, str(rd)))]
+                    if rd not in given_up
+                    and not os.path.exists(os.path.join(pub, str(rd)))]
+            if not todo:
+                return
             for attempt in range(attempts):
                 failed = []
                 for lo in range(0, len(todo), width):
@@ -463,15 +475,20 @@ def cmd_relay_archive(args) -> None:
                 put(r)
                 print(f"archived round {r.round}", flush=True)
                 # heal any hole between the watermark and this round
-                # (rounds produced during backfill, watch hiccups); on
-                # failure keep the watermark so the NEXT beacon retries
-                # the heal (fetch_span skips rounds already on disk)
+                # (rounds produced during backfill, watch hiccups). A
+                # round that still fails after the heal's own retries is
+                # given up on (logged, excluded from future heals) so one
+                # permanently unfetchable round cannot stall the relay.
                 if archived and r.round > archived + 1:
                     try:
                         await fetch_span(archived + 1, r.round - 1)
                     except SystemExit as e:
-                        print(f"gap heal deferred: {e}", flush=True)
-                        continue
+                        missing = [rd for rd in range(archived + 1, r.round)
+                                   if rd not in given_up and not os.path.
+                                   exists(os.path.join(pub, str(rd)))]
+                        given_up.update(missing)
+                        print(f"gap heal gave up on rounds {missing}: {e}",
+                              flush=True)
                 archived = max(archived, r.round)
         finally:
             await client.close()

@@ -1,0 +1,546 @@
+"""Batch-last BLS12-381 field/tower arithmetic for Pallas TPU kernels.
+
+The XLA graph engine (ops/limb.py, ops/tower.py, ops/pairing.py) dispatches
+tens of thousands of tiny HLOs per pairing — per-op overhead caps it at
+~3 pairing-checks/sec/batch-row. This module re-expresses the same
+arithmetic in a layout designed for *fused* Pallas kernels:
+
+    Fp    (..., 32, B)            limbs on SUBLANES, batch on LANES
+    Fp2   (..., 2, 32, B)
+    Fp6   (..., 3, 2, 32, B)
+    Fp12  (..., 2, 3, 2, 32, B)
+
+With B = 128 the trailing (32, 128) tile maps exactly onto the VPU's
+native (8, 128) vector registers: every elementwise op processes 128
+batch elements at full lane utilization, and limb shifts are sublane
+shifts. All functions are pure jnp compositions — usable inside Pallas
+kernel bodies (no gather, no scan, no pad with interior padding; only
+static slices, concatenations, multiplies and adds, all Mosaic-lowerable).
+
+Algorithms (12-bit limbs, Montgomery R = 2^384, lazy carries) mirror
+ops/limb.py / ops/tower.py and are golden-tested against the host
+reference drand_tpu.crypto.fields (tests/test_pallas_field.py).
+
+Reference hot-path equivalence: kyber-bls12381's assembly field backend
+(/root/reference/go.mod:9-10) — here the batch axis replaces instruction-
+level parallelism.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..crypto import fields as hf
+from ..crypto.fields import P
+from . import limb as _x  # host-side packing helpers + shared constants
+
+BITS = _x.BITS
+NLIMBS = _x.NLIMBS
+MASK = _x.MASK
+DTYPE = _x.DTYPE
+
+# conv strategy: "unroll" = 32 static shifted partial products (parallel,
+# bigger trace), "loop" = fori_loop accumulation (compact trace, serial).
+# Kernels read this at trace time; tests cover both.
+CONV_MODE = "unroll"
+
+
+# ---------------------------------------------------------------------------
+# Host-side packing (numpy; batch-last)
+# ---------------------------------------------------------------------------
+
+def pack_fp(values: list[int]) -> np.ndarray:
+    """ints -> (32, B) Montgomery-domain limbs."""
+    return np.stack([_x.int_to_mont_limbs(v) for v in values], axis=-1)
+
+
+def unpack_fp(a) -> list[int]:
+    """(32, B) -> canonical host ints."""
+    a = np.asarray(a)
+    return [_x.fp_from_device(a[..., j]) for j in range(a.shape[-1])]
+
+
+# ---------------------------------------------------------------------------
+# Device constants — ONE packed (K, 32) int32 buffer.
+#
+# Pallas kernels may not close over array constants ("captures constants —
+# pass them as inputs"), so every array constant lives in a single packed
+# buffer that kernels take as their first input and activate with
+# ``const_context``; outside kernels the module-level numpy copy is used
+# (a plain jnp constant in XLA graphs).
+# ---------------------------------------------------------------------------
+
+_GAMMA_ROWS = {
+    k: np.stack([
+        np.stack([_x.int_to_limbs(g.c0 * _x.R_MONT % P),
+                  _x.int_to_limbs(g.c1 * _x.R_MONT % P)])
+        for g in hf._FROBENIUS_GAMMA[k]
+    ]).reshape(12, NLIMBS)
+    for k in (1, 2, 3)
+}
+
+# p-2 bits, MSB-first, padded to 384 with trailing zeros, as (12, 32)
+_PM2_BITS_MSB = np.array([int(c) for c in bin(P - 2)[2:]], dtype=np.int32)
+PM2_NBITS = _PM2_BITS_MSB.shape[0]  # 381
+_PM2_ROWS = np.zeros(384, dtype=np.int32)
+_PM2_ROWS[:PM2_NBITS] = _PM2_BITS_MSB
+_PM2_ROWS = _PM2_ROWS.reshape(12, NLIMBS)
+
+_CONST_SECTIONS = [
+    ("P", np.asarray(_x.P_LIMBS, dtype=np.int32)[None, :]),
+    ("ONE", np.asarray(_x.ONE_MONT, dtype=np.int32)[None, :]),
+    ("NEG_ADDEND", np.asarray(_x._NEG_ADDEND, dtype=np.int32)[None, :]),
+    ("NPRIME", np.asarray(_x._NPRIME_LIMBS, dtype=np.int32)[None, :]),
+    ("WRAP", np.asarray(_x._WRAP_ROWS, dtype=np.int32)),
+    ("GAMMA1", _GAMMA_ROWS[1]),
+    ("GAMMA2", _GAMMA_ROWS[2]),
+    ("GAMMA3", _GAMMA_ROWS[3]),
+    ("PM2", _PM2_ROWS),
+]
+_OFFSETS: dict[str, tuple[int, int]] = {}
+_off = 0
+for _name, _rows in _CONST_SECTIONS:
+    _OFFSETS[_name] = (_off, _rows.shape[0])
+    _off += _rows.shape[0]
+CONST_BUFFER = np.concatenate([r for _, r in _CONST_SECTIONS], axis=0)
+CONST_BUFFER.setflags(write=False)
+
+_ACTIVE_BUF = None
+
+
+@contextlib.contextmanager
+def const_context(buf):
+    """Route constants through `buf` (a traced (K, 32) array — e.g. a
+    Pallas kernel input ref's value) for the ops traced inside."""
+    global _ACTIVE_BUF
+    prev = _ACTIVE_BUF
+    _ACTIVE_BUF = buf
+    try:
+        yield
+    finally:
+        _ACTIVE_BUF = prev
+
+
+def _cbuf():
+    if _ACTIVE_BUF is not None:
+        return _ACTIVE_BUF
+    return jnp.asarray(CONST_BUFFER)
+
+
+def _crow(name: str):
+    """(32, 1) column for single-row constants."""
+    off, n = _OFFSETS[name]
+    assert n == 1, name
+    return _cbuf()[off][:, None]
+
+
+def _csec(name: str):
+    """(n, 32) section."""
+    off, n = _OFFSETS[name]
+    return _cbuf()[off:off + n]
+
+
+def one_mont(shape_prefix, b):
+    return jnp.broadcast_to(_crow("ONE"),
+                            tuple(shape_prefix) + (NLIMBS, b))
+
+
+# ---------------------------------------------------------------------------
+# Carry folding / reduction (limb axis = -2)
+# ---------------------------------------------------------------------------
+
+def _shift_down_one(c):
+    """Prepend a zero limb row, drop the top row: carry := carry << 1 limb."""
+    z = jnp.zeros_like(c[..., :1, :])
+    return jnp.concatenate([z, c[..., :-1, :]], axis=-2)
+
+
+def _fold(t, rounds: int, grow: bool = True):
+    if grow:
+        z = jnp.zeros_like(t[..., :1, :])
+        t = jnp.concatenate([t, z], axis=-2)
+    for _ in range(rounds):
+        t = (t & MASK) + _shift_down_one(t >> BITS)
+    return t
+
+
+def _fold_drop(t, rounds: int):
+    for _ in range(rounds):
+        t = (t & MASK) + _shift_down_one(t >> BITS)
+    return t
+
+
+def _wrap(t, passes: int, fold_rounds: int = 3):
+    """Fold limbs >= NLIMBS back through 2^(12k) mod p."""
+    for _ in range(passes):
+        if t.shape[-2] <= NLIMBS:
+            break
+        lo, hi = t[..., :NLIMBS, :], t[..., NLIMBS:, :]
+        k = hi.shape[-2]
+        wrap_rows = _csec("WRAP")
+        red = jnp.zeros_like(lo)
+        for i in range(k):
+            row = wrap_rows[i][:, None]  # (32, 1)
+            red = red + hi[..., i:i + 1, :] * row
+        t = _fold(lo + red, rounds=fold_rounds, grow=True)
+    return t[..., :NLIMBS, :]
+
+
+def reduce_light(t):
+    """Normalize small overflows (limbs < 2^16). See limb.reduce_light for
+    the two-pass soundness argument."""
+    t = _fold(t, rounds=1, grow=True)
+    return _wrap(t, passes=2, fold_rounds=2)
+
+
+# ---------------------------------------------------------------------------
+# Field ops (Montgomery domain)
+# ---------------------------------------------------------------------------
+
+def add(a, b):
+    return reduce_light(a + b)
+
+
+def neg(b):
+    comp = (2 * MASK) - b
+    return reduce_light(comp + _crow("NEG_ADDEND"))
+
+
+def sub(a, b):
+    comp = (2 * MASK) - b
+    return reduce_light(a + comp + _crow("NEG_ADDEND"))
+
+
+def mul_small(a, k: int):
+    if not 0 <= k <= 15:
+        raise ValueError("mul_small constant out of domain (0..15)")
+    return reduce_light(a * k)
+
+
+def double(a):
+    return mul_small(a, 2)
+
+
+def _conv_unrolled(a, b, out_len: int):
+    """Schoolbook product convolution via static shifted partial products:
+    C[k] = sum_{i+j=k} a_i * b_j, limbs <= 2^29. Fully parallel."""
+    z = jnp.zeros_like(b)
+    # b_ext[j] = b[j - NLIMBS]: window slides give every shift statically
+    b_ext = jnp.concatenate([z, b, z], axis=-2)  # (..., 96, B)
+    terms = []
+    for i in range(NLIMBS):
+        # shift_i[k] = b[k - i] for k in [0, out_len)
+        win = b_ext[..., NLIMBS - i: NLIMBS - i + out_len, :]
+        terms.append(a[..., i:i + 1, :] * win)
+    return jnp.sum(jnp.stack(terms, axis=0), axis=0, dtype=DTYPE)
+
+
+def _conv_looped(a, b, out_len: int):
+    """Same convolution as a fori_loop (compact trace for huge kernels)."""
+    z = jnp.zeros_like(b)
+    b_ext = jnp.concatenate([z, b, z], axis=-2)
+
+    def body(i, acc):
+        win = jax.lax.dynamic_slice_in_dim(b_ext, NLIMBS - i, out_len,
+                                           axis=-2)
+        ai = jax.lax.dynamic_slice_in_dim(a, i, 1, axis=-2)
+        return acc + ai * win
+
+    init = jnp.zeros(a.shape[:-2] + (out_len, a.shape[-1]), DTYPE)
+    return jax.lax.fori_loop(0, NLIMBS, body, init)
+
+
+def _conv(a, b, out_len: int):
+    if CONV_MODE == "unroll":
+        return _conv_unrolled(a, b, out_len)
+    return _conv_looped(a, b, out_len)
+
+
+def mont_mul(a, b):
+    """Montgomery product a * b * R^-1 mod p (REDC) — see limb.mont_mul for
+    the quotient-bit argument. Identical algorithm, batch-last layout."""
+    t = _conv(a, b, 2 * NLIMBS)                     # (..., 64, B)
+    t = _fold(t, rounds=3, grow=True)               # (..., 65, B)
+    m = _conv(t[..., :NLIMBS, :], jnp.broadcast_to(
+        _crow("NPRIME"), t.shape[:-2] + (NLIMBS, t.shape[-1])),
+        NLIMBS)
+    m = _fold_drop(m, rounds=3)
+    u = _conv(m, jnp.broadcast_to(_crow("P"),
+                                  m.shape[:-2] + (NLIMBS, m.shape[-1])),
+              2 * NLIMBS)
+    z = jnp.zeros_like(u[..., :1, :])
+    u = jnp.concatenate([u, z], axis=-2) + t        # (..., 65, B)
+    u = _fold(u, rounds=3, grow=True)               # (..., 66, B)
+    k = jnp.any(u[..., :NLIMBS, :] != 0, axis=-2).astype(DTYPE)  # (..., B)
+    hi = u[..., NLIMBS:, :]
+    r = jnp.concatenate([hi[..., :1, :] + k[..., None, :], hi[..., 1:, :]],
+                        axis=-2)
+    return _wrap(_fold(r, rounds=1, grow=False), passes=2)
+
+
+def mont_sqr(a):
+    return mont_mul(a, a)
+
+
+def select(cond, a, b):
+    """cond has the batch shape of a without the (limb, B) trailing axes —
+    i.e. cond shape == a.shape[:-2]."""
+    return jnp.where(cond[..., None, None], a, b)
+
+
+# ---------------------------------------------------------------------------
+# Fp2 (c0 + c1*u, u^2 = -1): (..., 2, 32, B)
+# ---------------------------------------------------------------------------
+
+def f2(c0, c1):
+    return jnp.stack([c0, c1], axis=-3)
+
+
+def f2_add(a, b):
+    return reduce_light(a + b)
+
+
+def f2_sub(a, b):
+    return sub(a, b)
+
+
+def f2_neg(a):
+    return neg(a)
+
+
+def f2_mul(a, b):
+    a0, a1 = a[..., 0, :, :], a[..., 1, :, :]
+    b0, b1 = b[..., 0, :, :], b[..., 1, :, :]
+    # Karatsuba: 3 Fp products in one stacked mont_mul
+    pa = jnp.stack([a0, a1, add(a0, a1)], axis=-3)
+    pb = jnp.stack([b0, b1, add(b0, b1)], axis=-3)
+    v = mont_mul(pa, pb)
+    v0, v1, v2 = v[..., 0, :, :], v[..., 1, :, :], v[..., 2, :, :]
+    return f2(sub(v0, v1), sub(v2, add(v0, v1)))
+
+
+def f2_sqr(a):
+    a0, a1 = a[..., 0, :, :], a[..., 1, :, :]
+    pa = jnp.stack([add(a0, a1), a0], axis=-3)
+    pb = jnp.stack([sub(a0, a1), a1], axis=-3)
+    v = mont_mul(pa, pb)
+    return f2(v[..., 0, :, :], double(v[..., 1, :, :]))
+
+
+def f2_mul_fp(a, s):
+    """Fp2 * Fp (s: (..., 32, B))."""
+    return mont_mul(a, s[..., None, :, :])
+
+
+def f2_mul_small(a, k: int):
+    return mul_small(a, k)
+
+
+def f2_conj(a):
+    return f2(a[..., 0, :, :], neg(a[..., 1, :, :]))
+
+
+def f2_mul_by_xi(a):
+    a0, a1 = a[..., 0, :, :], a[..., 1, :, :]
+    return f2(sub(a0, a1), add(a0, a1))
+
+
+def f2_select(cond, a, b):
+    return jnp.where(cond[..., None, None, None], a, b)
+
+
+# ---------------------------------------------------------------------------
+# Fp6 (over Fp2, v^3 = xi): (..., 3, 2, 32, B)
+# ---------------------------------------------------------------------------
+
+def f6(c0, c1, c2):
+    return jnp.stack([c0, c1, c2], axis=-4)
+
+
+def f6_add(a, b):
+    return reduce_light(a + b)
+
+
+def f6_sub(a, b):
+    return sub(a, b)
+
+
+def f6_neg(a):
+    return neg(a)
+
+
+def f6_mul(a, b):
+    a0, a1, a2 = a[..., 0, :, :, :], a[..., 1, :, :, :], a[..., 2, :, :, :]
+    b0, b1, b2 = b[..., 0, :, :, :], b[..., 1, :, :, :], b[..., 2, :, :, :]
+    pa = jnp.stack([a0, a1, a2,
+                    f2_add(a1, a2), f2_add(a0, a1), f2_add(a0, a2)], axis=-4)
+    pb = jnp.stack([b0, b1, b2,
+                    f2_add(b1, b2), f2_add(b0, b1), f2_add(b0, b2)], axis=-4)
+    v = f2_mul(pa, pb)
+    v0, v1, v2 = v[..., 0, :, :, :], v[..., 1, :, :, :], v[..., 2, :, :, :]
+    m12, m01, m02 = (v[..., 3, :, :, :], v[..., 4, :, :, :],
+                     v[..., 5, :, :, :])
+    c0 = f2_add(v0, f2_mul_by_xi(f2_sub(m12, f2_add(v1, v2))))
+    c1 = f2_add(f2_sub(m01, f2_add(v0, v1)), f2_mul_by_xi(v2))
+    c2 = f2_add(f2_sub(m02, f2_add(v0, v2)), v1)
+    return f6(c0, c1, c2)
+
+
+def f6_sqr(a):
+    return f6_mul(a, a)
+
+
+def f6_mul_by_v(a):
+    a0, a1, a2 = a[..., 0, :, :, :], a[..., 1, :, :, :], a[..., 2, :, :, :]
+    return f6(f2_mul_by_xi(a2), a0, a1)
+
+
+# ---------------------------------------------------------------------------
+# Fp12 (over Fp6, w^2 = v): (..., 2, 3, 2, 32, B)
+# ---------------------------------------------------------------------------
+
+def f12(c0, c1):
+    return jnp.stack([c0, c1], axis=-5)
+
+
+def f12_one(shape_prefix, b):
+    out = jnp.zeros(tuple(shape_prefix) + (2, 3, 2, NLIMBS, b), DTYPE)
+    return out.at[..., 0, 0, 0, :, :].set(_crow("ONE"))
+
+
+def f12_mul(a, b):
+    a0, a1 = a[..., 0, :, :, :, :], a[..., 1, :, :, :, :]
+    b0, b1 = b[..., 0, :, :, :, :], b[..., 1, :, :, :, :]
+    pa = jnp.stack([a0, a1, f6_add(a0, a1)], axis=-5)
+    pb = jnp.stack([b0, b1, f6_add(b0, b1)], axis=-5)
+    v = f6_mul(pa, pb)
+    v0 = v[..., 0, :, :, :, :]
+    v1 = v[..., 1, :, :, :, :]
+    v2 = v[..., 2, :, :, :, :]
+    return f12(f6_add(v0, f6_mul_by_v(v1)), f6_sub(v2, f6_add(v0, v1)))
+
+
+def f12_sqr(a):
+    a0, a1 = a[..., 0, :, :, :, :], a[..., 1, :, :, :, :]
+    v0 = f6_mul(a0, a1)
+    c0 = f6_sub(f6_mul(f6_add(a0, a1), f6_add(a0, f6_mul_by_v(a1))),
+                f6_add(v0, f6_mul_by_v(v0)))
+    return f12(c0, f6_add(v0, v0))
+
+
+def f12_conj(a):
+    return f12(a[..., 0, :, :, :, :], f6_neg(a[..., 1, :, :, :, :]))
+
+
+def f12_select(cond, a, b):
+    return jnp.where(cond[..., None, None, None, None, None], a, b)
+
+
+# -- w-basis ----------------------------------------------------------------
+
+def f12_to_w(a):
+    """(..., 2, 3, 2, 32, B) -> (..., 6, 2, 32, B) in w-power order."""
+    c0, c1 = a[..., 0, :, :, :, :], a[..., 1, :, :, :, :]
+    return jnp.stack([
+        c0[..., 0, :, :, :], c1[..., 0, :, :, :], c0[..., 1, :, :, :],
+        c1[..., 1, :, :, :], c0[..., 2, :, :, :], c1[..., 2, :, :, :],
+    ], axis=-4)
+
+
+def f12_from_w(w):
+    c0 = jnp.stack([w[..., 0, :, :, :], w[..., 2, :, :, :],
+                    w[..., 4, :, :, :]], axis=-4)
+    c1 = jnp.stack([w[..., 1, :, :, :], w[..., 3, :, :, :],
+                    w[..., 5, :, :, :]], axis=-4)
+    return f12(c0, c1)
+
+
+# -- Frobenius --------------------------------------------------------------
+
+def f12_frobenius(a, power: int = 1):
+    w = f12_to_w(a)
+    if power % 2 == 1:
+        w = f2_conj(w)
+    # (6, 2, 32, 1) — broadcasts over batch lanes
+    gam = _csec(f"GAMMA{power}").reshape(6, 2, NLIMBS)[..., None]
+    return f12_from_w(f2_mul(w, gam))
+
+
+# -- cyclotomic squaring ----------------------------------------------------
+
+def f12_cyclotomic_sqr(a):
+    w = f12_to_w(a)
+    g = [w[..., i, :, :, :] for i in range(6)]
+
+    def sq2(x, y):
+        t0 = f2_sqr(x)
+        t1 = f2_sqr(y)
+        return f2_add(t0, f2_mul_by_xi(t1)), f2_sub(f2_sqr(f2_add(x, y)),
+                                                    f2_add(t0, t1))
+
+    a0, a1 = sq2(g[0], g[3])
+    b0, b1 = sq2(g[1], g[4])
+    c0, c1 = sq2(g[2], g[5])
+
+    def fmi(goal, t):  # 3t - 2*goal
+        return f2_add(f2_mul_small(f2_sub(t, goal), 2), t)
+
+    def gpl(goal, t):  # 3t + 2*goal
+        return f2_add(f2_mul_small(f2_add(t, goal), 2), t)
+
+    h = [fmi(g[0], a0), gpl(g[1], f2_mul_by_xi(c1)), fmi(g[2], b0),
+         gpl(g[3], a1), fmi(g[4], c0), gpl(g[5], b1)]
+    return f12_from_w(jnp.stack(h, axis=-4))
+
+
+# ---------------------------------------------------------------------------
+# Inversion (Fermat at the bottom; tower formulas above)
+# ---------------------------------------------------------------------------
+
+def fp_inv(a):
+    """a^(p-2) — MSB-first square-and-multiply fori_loop; exponent bits
+    come from the PM2 section of the constant buffer ((12, 32) layout,
+    dynamically indexed per step — SMEM/VMEM-friendly scalar reads)."""
+    bits = _csec("PM2")
+
+    def body(i, acc):
+        acc = mont_sqr(acc)
+        m = mont_mul(acc, a)
+        bit = jax.lax.dynamic_slice(bits, (i // NLIMBS, i % NLIMBS),
+                                    (1, 1))[0, 0]
+        return jnp.where(bit != 0, m, acc)
+
+    init = jnp.broadcast_to(_crow("ONE"), a.shape).astype(DTYPE)
+    return jax.lax.fori_loop(0, PM2_NBITS, body, init)
+
+
+def f2_inv(a):
+    a0, a1 = a[..., 0, :, :], a[..., 1, :, :]
+    sq = mont_mul(jnp.stack([a0, a1], axis=-3),
+                  jnp.stack([a0, a1], axis=-3))
+    norm = add(sq[..., 0, :, :], sq[..., 1, :, :])
+    t = fp_inv(norm)
+    return f2(mont_mul(a0, t), neg(mont_mul(a1, t)))
+
+
+def f6_inv(a):
+    a0, a1, a2 = a[..., 0, :, :, :], a[..., 1, :, :, :], a[..., 2, :, :, :]
+    t0 = f2_sub(f2_sqr(a0), f2_mul_by_xi(f2_mul(a1, a2)))
+    t1 = f2_sub(f2_mul_by_xi(f2_sqr(a2)), f2_mul(a0, a1))
+    t2 = f2_sub(f2_sqr(a1), f2_mul(a0, a2))
+    denom = f2_add(f2_mul(a0, t0),
+                   f2_add(f2_mul_by_xi(f2_mul(a2, t1)),
+                          f2_mul_by_xi(f2_mul(a1, t2))))
+    dinv = f2_inv(denom)
+    return f6(f2_mul(t0, dinv), f2_mul(t1, dinv), f2_mul(t2, dinv))
+
+
+def f12_inv(a):
+    a0, a1 = a[..., 0, :, :, :, :], a[..., 1, :, :, :, :]
+    denom = f6_sub(f6_sqr(a0), f6_mul_by_v(f6_sqr(a1)))
+    dinv = f6_inv(denom)
+    return f12(f6_mul(a0, dinv), f6_neg(f6_mul(a1, dinv)))

@@ -1,0 +1,164 @@
+"""Golden tests: batch-last field/tower arithmetic (ops/bl.py) vs the host
+reference (crypto/fields.py), both as plain jnp math and inside a real
+Pallas kernel (interpret mode on CPU; the TPU path is exercised by the
+engine's known-answer validation and bench.py)."""
+
+import random
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from drand_tpu.crypto import fields as hf
+from drand_tpu.crypto.fields import P
+from drand_tpu.ops import bl
+
+B = 8  # batch lanes under test (kernels use 128; math is lane-agnostic)
+rng = random.Random(0xB117)
+
+
+def rand_fp_ints(n=B):
+    return [rng.randrange(P) for _ in range(n)]
+
+
+def rand_f2():
+    return [hf.Fp2(rng.randrange(P), rng.randrange(P)) for _ in range(B)]
+
+
+def rand_f6():
+    return [hf.Fp6(*(rand_f2()[0] for _ in range(3))) for _ in range(B)]
+
+
+def rand_f12():
+    return [hf.Fp12(rand_f6()[0], rand_f6()[0]) for _ in range(B)]
+
+
+# -- packing helpers --------------------------------------------------------
+
+def pack_f2(xs):
+    return np.stack([bl.pack_fp([x.c0 for x in xs]),
+                     bl.pack_fp([x.c1 for x in xs])], axis=0)
+
+
+def unpack_f2(a):
+    c0 = bl.unpack_fp(np.asarray(a)[0])
+    c1 = bl.unpack_fp(np.asarray(a)[1])
+    return [hf.Fp2(x, y) for x, y in zip(c0, c1)]
+
+
+def pack_f6(xs):
+    return np.stack([pack_f2([x.c0 for x in xs]),
+                     pack_f2([x.c1 for x in xs]),
+                     pack_f2([x.c2 for x in xs])], axis=0)
+
+
+def unpack_f6(a):
+    a = np.asarray(a)
+    return [hf.Fp6(x, y, z) for x, y, z in zip(
+        unpack_f2(a[0]), unpack_f2(a[1]), unpack_f2(a[2]))]
+
+
+def pack_f12(xs):
+    return np.stack([pack_f6([x.c0 for x in xs]),
+                     pack_f6([x.c1 for x in xs])], axis=0)
+
+
+def unpack_f12(a):
+    a = np.asarray(a)
+    return [hf.Fp12(x, y) for x, y in zip(unpack_f6(a[0]), unpack_f6(a[1]))]
+
+
+# -- Fp ---------------------------------------------------------------------
+
+def test_mont_mul_add_sub_neg_golden():
+    xs, ys = rand_fp_ints(), rand_fp_ints()
+    a, b = jnp.asarray(bl.pack_fp(xs)), jnp.asarray(bl.pack_fp(ys))
+    assert bl.unpack_fp(bl.mont_mul(a, b)) == [x * y % P
+                                               for x, y in zip(xs, ys)]
+    assert bl.unpack_fp(bl.add(a, b)) == [(x + y) % P
+                                          for x, y in zip(xs, ys)]
+    assert bl.unpack_fp(bl.sub(a, b)) == [(x - y) % P
+                                          for x, y in zip(xs, ys)]
+    assert bl.unpack_fp(bl.neg(b)) == [(-y) % P for y in ys]
+    assert bl.unpack_fp(bl.mul_small(a, 9)) == [x * 9 % P for x in xs]
+
+
+def test_conv_modes_agree():
+    xs, ys = rand_fp_ints(), rand_fp_ints()
+    a, b = jnp.asarray(bl.pack_fp(xs)), jnp.asarray(bl.pack_fp(ys))
+    prev = bl.CONV_MODE
+    try:
+        bl.CONV_MODE = "unroll"
+        out_u = np.asarray(bl.mont_mul(a, b))
+        bl.CONV_MODE = "loop"
+        out_l = np.asarray(bl.mont_mul(a, b))
+    finally:
+        bl.CONV_MODE = prev
+    assert bl.unpack_fp(out_u) == bl.unpack_fp(out_l)
+
+
+def test_fp_inv_golden():
+    xs = rand_fp_ints()
+    a = jnp.asarray(bl.pack_fp(xs))
+    assert bl.unpack_fp(bl.fp_inv(a)) == [pow(x, P - 2, P) for x in xs]
+
+
+# -- Fp2 / Fp6 / Fp12 -------------------------------------------------------
+
+def test_f2_ops_golden():
+    xs, ys = rand_f2(), rand_f2()
+    a, b = jnp.asarray(pack_f2(xs)), jnp.asarray(pack_f2(ys))
+    assert unpack_f2(bl.f2_mul(a, b)) == [x * y for x, y in zip(xs, ys)]
+    assert unpack_f2(bl.f2_sqr(a)) == [x * x for x in xs]
+    assert unpack_f2(bl.f2_mul_by_xi(a)) == [x * hf.XI for x in xs]
+    assert unpack_f2(bl.f2_inv(a)) == [x.inverse() for x in xs]
+    assert unpack_f2(bl.f2_conj(a)) == [x.conjugate() for x in xs]
+
+
+def test_f6_f12_ops_golden():
+    x6, y6 = rand_f6(), rand_f6()
+    a6, b6 = jnp.asarray(pack_f6(x6)), jnp.asarray(pack_f6(y6))
+    assert unpack_f6(bl.f6_mul(a6, b6)) == [x * y for x, y in zip(x6, y6)]
+    assert unpack_f6(bl.f6_inv(a6)) == [x.inverse() for x in x6]
+    x12, y12 = rand_f12(), rand_f12()
+    a12, b12 = jnp.asarray(pack_f12(x12)), jnp.asarray(pack_f12(y12))
+    assert unpack_f12(bl.f12_mul(a12, b12)) == [x * y
+                                                for x, y in zip(x12, y12)]
+    assert unpack_f12(bl.f12_sqr(a12)) == [x * x for x in x12]
+    assert unpack_f12(bl.f12_conj(a12)) == [x.conjugate() for x in x12]
+    assert unpack_f12(bl.f12_inv(a12)) == [x.inverse() for x in x12]
+    for k in (1, 2, 3):
+        assert unpack_f12(bl.f12_frobenius(a12, k)) == \
+            [x.frobenius(k) for x in x12]
+
+
+def test_cyclotomic_sqr_golden():
+    # cyclotomic elements: m^((p^6-1)(p^2+1)) for random m
+    xs = []
+    for x in rand_f12()[:3]:
+        e = x.frobenius(3).frobenius(3) * x.inverse()  # x^(p^6-1)
+        xs.append(e.frobenius(2) * e)                  # ^(p^2+1)
+    a = jnp.asarray(pack_f12(xs))
+    assert unpack_f12(bl.f12_cyclotomic_sqr(a)) == \
+        [x.cyclotomic_square() for x in xs]
+
+
+# -- inside a real Pallas kernel (interpret mode) ---------------------------
+
+def test_f2_mul_inside_pallas_kernel_interpret():
+    from jax.experimental import pallas as pl
+
+    xs, ys = rand_f2(), rand_f2()
+    a, b = jnp.asarray(pack_f2(xs)), jnp.asarray(pack_f2(ys))
+
+    def kernel(c_ref, a_ref, b_ref, o_ref):
+        with bl.const_context(c_ref[:]):
+            o_ref[:] = bl.f2_mul(a_ref[:], b_ref[:])
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(a.shape, a.dtype),
+        interpret=True,
+    )(jnp.asarray(bl.CONST_BUFFER), a, b)
+    assert unpack_f2(out) == [x * y for x, y in zip(xs, ys)]
