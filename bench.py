@@ -16,12 +16,14 @@ Prints exactly ONE JSON line:
      "vs_baseline": N / 200000}
 Progress/diagnostics go to stderr. Environment knobs:
     BENCH_BATCH       comma-separated batch sizes to try, largest first
-                      (default "64,16"); each batch's results are
-                      self-checked against the host truth and a failing
-                      batch size is skipped — the axon TPU backend
-                      currently miscompiles the pairing graph at batches
-                      >= ~64 (see ops/pairing.py docstring), so the
-                      largest CORRECT batch wins
+                      (default "128,16,8,4"). Sizes >= PALLAS_MIN_BUCKET
+                      run the fused Mosaic kernel path
+                      (ops/pallas_pairing.py); smaller ones run the XLA
+                      graph (which the axon backend currently miscompiles
+                      at batches >= ~16 — ops/engine.py DEFAULT_BUCKETS).
+                      Every size is self-checked (positive AND negative)
+                      against host truth; a failing size is skipped, the
+                      largest CORRECT one wins.
     BENCH_MIN_SECONDS minimum timed window (default 5.0)
 """
 
@@ -51,7 +53,7 @@ def main() -> None:
     from drand_tpu.ops import limb, pairing
 
     batches = [int(b) for b in
-               os.environ.get("BENCH_BATCH", "64,16,8,4").split(",")]
+               os.environ.get("BENCH_BATCH", "128,16,8,4").split(",")]
     min_seconds = float(os.environ.get("BENCH_MIN_SECONDS", "5.0"))
     log(f"backend={jax.default_backend()} devices={jax.devices()} "
         f"batches={batches}")
@@ -73,28 +75,60 @@ def main() -> None:
         pool_sigs.append(_g2_aff(
             PointG2.from_bytes(bls.sign(sk, msg), subgroup_check=False)))
     log(f"host prep: {time.perf_counter() - t_prep:.1f}s")
-    verify = jax.jit(pairing.verify_prepared)
+    verify_xla = jax.jit(pairing.verify_prepared)
+
+    from drand_tpu.ops import pallas_pairing
+    from drand_tpu.ops.engine import PALLAS_MIN_BUCKET
 
     rate = None
     for batch in batches:
         pubs = np.broadcast_to(pub_aff, (batch, 2, limb.NLIMBS))
         sigs = np.stack([pool_sigs[i % pool] for i in range(batch)])
         msgs = np.stack([pool_msgs[i % pool] for i in range(batch)])
-        pubs_d, sigs_d, msgs_d = (jnp.asarray(pubs), jnp.asarray(sigs),
-                                  jnp.asarray(msgs))
+        use_pallas = batch >= PALLAS_MIN_BUCKET
+        if use_pallas:
+            # engine-path: fused Mosaic kernels (ops/pallas_pairing.py).
+            # Inputs are packed to the batch-last device layout ONCE —
+            # the timed loop measures the jitted kernel chain, not
+            # per-call host packing.
+            def verify(x, y, qq):
+                return pallas_pairing._verify_pl(x, y, qq, npairs=2,
+                                                 b=batch)
+            args = pallas_pairing.pack_verify_inputs(pubs, sigs, msgs)
+
+            def repack(bad_s):
+                return pallas_pairing.pack_verify_inputs(pubs, bad_s, msgs)
+        else:
+            verify = verify_xla
+            args = (jnp.asarray(pubs), jnp.asarray(sigs), jnp.asarray(msgs))
+
+            def repack(bad_s):
+                return (args[0], jnp.asarray(bad_s), args[2])
         t0 = time.perf_counter()
-        out = np.asarray(verify(pubs_d, sigs_d, msgs_d))
-        log(f"batch {batch}: first call (compile+run) "
-            f"{time.perf_counter() - t0:.1f}s")
+        try:
+            out = np.asarray(verify(*args))
+        except Exception as e:  # noqa: BLE001 — probe the next size
+            log(f"batch {batch} ({'pallas' if use_pallas else 'xla'}): "
+                f"failed to compile/run: {e!r} — skipping")
+            continue
+        log(f"batch {batch} ({'pallas' if use_pallas else 'xla'}): "
+            f"first call (compile+run) {time.perf_counter() - t0:.1f}s")
         if not out.all():
             log(f"batch {batch}: verification returned False on valid "
-                f"inputs (known axon large-batch miscompile) — skipping")
+                f"inputs (known axon backend miscompile) — skipping")
+            continue
+        # negative self-check: a corrupted signature row must fail
+        bad_sigs = sigs.copy()
+        bad_sigs[0] = pool_sigs[(1) % pool]  # sig for a different message
+        bad_out = np.asarray(verify(*repack(bad_sigs)))
+        if bad_out[0] or not bad_out[1:].all():
+            log(f"batch {batch}: negative self-check failed — skipping")
             continue
         calls = 0
         t0 = time.perf_counter()
         deadline = t0 + min_seconds
         while time.perf_counter() < deadline or calls < 3:
-            verify(pubs_d, sigs_d, msgs_d).block_until_ready()
+            np.asarray(verify(*args))
             calls += 1
         dt = time.perf_counter() - t0
         rate = 2 * batch * calls / dt

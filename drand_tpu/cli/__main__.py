@@ -426,6 +426,8 @@ def cmd_relay_archive(args) -> None:
             os.replace(tmp, path)
 
         given_up: set[int] = set()
+        heal_fails: dict[int, int] = {}
+        GIVE_UP_AFTER = 5  # heal cycles before a round is abandoned
 
         async def fetch_span(start: int, end: int, width: int = 16,
                              attempts: int = 3) -> None:
@@ -476,9 +478,13 @@ def cmd_relay_archive(args) -> None:
                 print(f"archived round {r.round}", flush=True)
                 # heal any hole between the watermark and this round
                 # (rounds produced during backfill, watch hiccups). A
-                # round that still fails after the heal's own retries is
-                # given up on (logged, excluded from future heals) so one
-                # permanently unfetchable round cannot stall the relay.
+                # transient source outage is retried across GIVE_UP_AFTER
+                # heal cycles (the watermark stays put so the next beacon
+                # retries; on-disk rounds are skipped, so retries only
+                # touch the still-missing ones); only a round that fails
+                # that many cycles is abandoned — bounding the stall a
+                # permanently unfetchable round can cause without turning
+                # one outage into a permanent archive hole.
                 if archived and r.round > archived + 1:
                     try:
                         await fetch_span(archived + 1, r.round - 1)
@@ -486,9 +492,19 @@ def cmd_relay_archive(args) -> None:
                         missing = [rd for rd in range(archived + 1, r.round)
                                    if rd not in given_up and not os.path.
                                    exists(os.path.join(pub, str(rd)))]
-                        given_up.update(missing)
-                        print(f"gap heal gave up on rounds {missing}: {e}",
-                              flush=True)
+                        abandoned = []
+                        for rd in missing:
+                            heal_fails[rd] = heal_fails.get(rd, 0) + 1
+                            if heal_fails[rd] >= GIVE_UP_AFTER:
+                                given_up.add(rd)
+                                heal_fails.pop(rd)
+                                abandoned.append(rd)
+                        if abandoned:
+                            print(f"gap heal gave up on rounds "
+                                  f"{abandoned}: {e}", flush=True)
+                        if set(missing) - given_up:
+                            print(f"gap heal deferred: {e}", flush=True)
+                            continue  # keep watermark; retry next beacon
                 archived = max(archived, r.round)
         finally:
             await client.close()

@@ -29,6 +29,7 @@ level parallelism.
 from __future__ import annotations
 
 import contextlib
+import functools
 
 import numpy as np
 import jax
@@ -90,6 +91,13 @@ _PM2_ROWS = np.zeros(384, dtype=np.int32)
 _PM2_ROWS[:PM2_NBITS] = _PM2_BITS_MSB
 _PM2_ROWS = _PM2_ROWS.reshape(12, NLIMBS)
 
+# multiples of p below ~2^384 (k*p, k = 0..K-1), 33 limbs each — the
+# exact-equality table behind is_zero_mod_p (low 32 limbs in the const
+# buffer; the top limb is compared as a host int scalar)
+_PMULT_33 = np.stack([_x.int_to_limbs(k * P, NLIMBS + 1)
+                      for k in range(_x.R_MONT // P + 1)])
+N_PMULT = _PMULT_33.shape[0]
+
 _CONST_SECTIONS = [
     ("P", np.asarray(_x.P_LIMBS, dtype=np.int32)[None, :]),
     ("ONE", np.asarray(_x.ONE_MONT, dtype=np.int32)[None, :]),
@@ -100,6 +108,7 @@ _CONST_SECTIONS = [
     ("GAMMA2", _GAMMA_ROWS[2]),
     ("GAMMA3", _GAMMA_ROWS[3]),
     ("PM2", _PM2_ROWS),
+    ("PMULT_LO", _PMULT_33[:, :NLIMBS].astype(np.int32)),
 ]
 _OFFSETS: dict[str, tuple[int, int]] = {}
 _off = 0
@@ -408,8 +417,15 @@ def f12(c0, c1):
 
 
 def f12_one(shape_prefix, b):
-    out = jnp.zeros(tuple(shape_prefix) + (2, 3, 2, NLIMBS, b), DTYPE)
-    return out.at[..., 0, 0, 0, :, :].set(_crow("ONE"))
+    """Built by stacking (no scatter — Mosaic has no scatter lowering)."""
+    pre = tuple(shape_prefix)
+    one_fp = jnp.broadcast_to(_crow("ONE"), pre + (NLIMBS, b)).astype(DTYPE)
+    z_fp = jnp.zeros(pre + (NLIMBS, b), DTYPE)
+    f2_one_ = jnp.stack([one_fp, z_fp], axis=-3)
+    f2_z = jnp.zeros(pre + (2, NLIMBS, b), DTYPE)
+    f6_one_ = jnp.stack([f2_one_, f2_z, f2_z], axis=-4)
+    f6_z = jnp.zeros(pre + (3, 2, NLIMBS, b), DTYPE)
+    return jnp.stack([f6_one_, f6_z], axis=-5)
 
 
 def f12_mul(a, b):
@@ -501,33 +517,45 @@ def f12_cyclotomic_sqr(a):
 # Inversion (Fermat at the bottom; tower formulas above)
 # ---------------------------------------------------------------------------
 
-def fp_inv(a):
-    """a^(p-2) — MSB-first square-and-multiply fori_loop; exponent bits
-    come from the PM2 section of the constant buffer ((12, 32) layout,
-    dynamically indexed per step — SMEM/VMEM-friendly scalar reads)."""
+def default_pm2_getter():
+    """Bit getter over the PM2 constant-buffer section — XLA path only
+    (Mosaic has no dynamic_slice on values; kernels pass an SMEM-ref
+    getter instead)."""
     bits = _csec("PM2")
+
+    def get(i):
+        return jax.lax.dynamic_slice(bits, (i // NLIMBS, i % NLIMBS),
+                                     (1, 1))[0, 0]
+
+    return get
+
+
+def fp_inv(a, bit_getter=None):
+    """a^(p-2) — MSB-first square-and-multiply fori_loop; ``bit_getter(i)``
+    returns the i-th exponent bit as a traced scalar (MSB-first over
+    PM2_NBITS bits)."""
+    if bit_getter is None:
+        bit_getter = default_pm2_getter()
 
     def body(i, acc):
         acc = mont_sqr(acc)
         m = mont_mul(acc, a)
-        bit = jax.lax.dynamic_slice(bits, (i // NLIMBS, i % NLIMBS),
-                                    (1, 1))[0, 0]
-        return jnp.where(bit != 0, m, acc)
+        return jnp.where(bit_getter(i) != 0, m, acc)
 
     init = jnp.broadcast_to(_crow("ONE"), a.shape).astype(DTYPE)
     return jax.lax.fori_loop(0, PM2_NBITS, body, init)
 
 
-def f2_inv(a):
+def f2_inv(a, bit_getter=None):
     a0, a1 = a[..., 0, :, :], a[..., 1, :, :]
     sq = mont_mul(jnp.stack([a0, a1], axis=-3),
                   jnp.stack([a0, a1], axis=-3))
     norm = add(sq[..., 0, :, :], sq[..., 1, :, :])
-    t = fp_inv(norm)
+    t = fp_inv(norm, bit_getter)
     return f2(mont_mul(a0, t), neg(mont_mul(a1, t)))
 
 
-def f6_inv(a):
+def f6_inv(a, bit_getter=None):
     a0, a1, a2 = a[..., 0, :, :, :], a[..., 1, :, :, :], a[..., 2, :, :, :]
     t0 = f2_sub(f2_sqr(a0), f2_mul_by_xi(f2_mul(a1, a2)))
     t1 = f2_sub(f2_mul_by_xi(f2_sqr(a2)), f2_mul(a0, a1))
@@ -535,12 +563,55 @@ def f6_inv(a):
     denom = f2_add(f2_mul(a0, t0),
                    f2_add(f2_mul_by_xi(f2_mul(a2, t1)),
                           f2_mul_by_xi(f2_mul(a1, t2))))
-    dinv = f2_inv(denom)
+    dinv = f2_inv(denom, bit_getter)
     return f6(f2_mul(t0, dinv), f2_mul(t1, dinv), f2_mul(t2, dinv))
 
 
-def f12_inv(a):
+def f12_inv(a, bit_getter=None):
     a0, a1 = a[..., 0, :, :, :, :], a[..., 1, :, :, :, :]
     denom = f6_sub(f6_sqr(a0), f6_mul_by_v(f6_sqr(a1)))
-    dinv = f6_inv(denom)
+    dinv = f6_inv(denom, bit_getter)
     return f12(f6_mul(a0, dinv), f6_neg(f6_mul(a1, dinv)))
+
+
+# ---------------------------------------------------------------------------
+# Exact zero test (kernel-safe: static carry unroll, no scan/dynamic slices)
+# ---------------------------------------------------------------------------
+
+def exact_normalize(t):
+    """(..., 32, B) engine-invariant limbs -> (..., 33, B) exact limbs in
+    [0, MASK] with the carry-out appended. Static 32-step carry chain —
+    fine inside Pallas kernels (trace is ~100 tiny ops)."""
+    rows = [t[..., i, :] for i in range(NLIMBS)]
+    out = []
+    carry = jnp.zeros_like(rows[0])
+    for i in range(NLIMBS):
+        s = rows[i] + carry
+        out.append(s & MASK)
+        carry = s >> BITS
+    out.append(carry)
+    return jnp.stack(out, axis=-2)
+
+
+def is_zero_mod_p(a):
+    """True (per batch lane) where the value of ``a`` is ≡ 0 mod p —
+    sound for any engine-invariant input < ~2^384(1+eps): exact-normalize
+    then compare against every multiple of p in range."""
+    norm = exact_normalize(a)  # (..., 33, B)
+    lo = _csec("PMULT_LO")     # (K, 32)
+    eqs = []
+    for k in range(N_PMULT):
+        ok_lo = jnp.all(norm[..., :NLIMBS, :] == lo[k][:, None], axis=-2)
+        # top limb vs a PYTHON INT scalar — a (1,1)-vector comparison would
+        # need a both-sublanes-and-lanes broadcast, which Mosaic lacks
+        ok_hi = norm[..., NLIMBS, :] == int(_PMULT_33[k, NLIMBS])
+        eqs.append(ok_lo & ok_hi)
+    return functools.reduce(jnp.logical_or, eqs)
+
+
+def f12_is_one(a):
+    """==1 (Montgomery) per batch lane for (..., 2, 3, 2, 32, B)."""
+    d = sub(a, f12_one(a.shape[:-5], a.shape[-1]))
+    flat = d.reshape(d.shape[:-5] + (12, NLIMBS, d.shape[-1]))
+    z = is_zero_mod_p(flat)  # (..., 12, B)
+    return jnp.all(z, axis=-2)

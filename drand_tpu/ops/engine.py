@@ -44,13 +44,17 @@ from . import curve, limb, pairing, tower
 # cold cache) — keep the set small. Batches above the top bucket split
 # into multiple top-bucket calls.
 #
-# Top bucket 16: the axon TPU stack currently returns WRONG results for
-# this graph at batch >= ~64 (libtpu version skew between the client's AOT
-# compiler and the terminal runtime — the runtime itself warns the
-# executable "may diverge"; B=16 verified correct, B=64 verified wrong,
-# CPU correct at every size). Raise once the fleet's libtpu is in sync —
-# bench.py probes 64 first and will pick it up automatically.
-DEFAULT_BUCKETS = (4, 16)
+# Buckets >= PALLAS_MIN_BUCKET run the fused batch-last Pallas path
+# (ops/pallas_pairing.py — Mosaic-compiled kernels, per-kernel fusion);
+# smaller buckets run the XLA graph (ops/pairing.py). The axon TPU stack
+# currently returns WRONG results for the XLA graph at batch >= ~16
+# (libtpu version skew between the client's AOT compiler and the terminal
+# runtime; CPU correct at every size) — the Pallas path both dodges that
+# compiler path and removes the per-op dispatch overhead. Every bucket is
+# still known-answer-validated before first use; failing buckets are
+# disabled automatically.
+DEFAULT_BUCKETS = (4, 128)
+PALLAS_MIN_BUCKET = int(os.environ.get("DRAND_TPU_PALLAS_MIN", "32"))
 
 
 def _bucket(n: int, buckets) -> int:
@@ -218,8 +222,15 @@ class BatchedEngine:
                 continue
             pubs[i], sigs[i], msgs[i] = _g1_aff(pub), _g2_aff(sig), _g2_aff(msg_pt)
             valid[i] = True
-        ok = np.asarray(self._verify(jnp.asarray(pubs), jnp.asarray(sigs),
-                                     jnp.asarray(msgs)))
+        if b >= PALLAS_MIN_BUCKET:
+            from . import pallas_pairing
+
+            ok = np.asarray(pallas_pairing.verify_prepared_pl(
+                pubs, sigs, msgs))
+        else:
+            ok = np.asarray(self._verify(jnp.asarray(pubs),
+                                         jnp.asarray(sigs),
+                                         jnp.asarray(msgs)))
         return (ok & valid)[:n]
 
     def verify_beacons(self, pubkey: PointG1, beacons,

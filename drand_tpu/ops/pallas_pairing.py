@@ -1,0 +1,417 @@
+"""Fused batch-last pairing: Pallas TPU kernels for the BLS hot path.
+
+The XLA graph pairing (ops/pairing.py) is correct but dispatch-bound
+(~50k tiny HLOs per call) and, on the current axon stack, miscompiled
+above small batch sizes. This module re-expresses the SAME mathematics
+(M-twist denominator-eliminated Miller loop, Hayashida final
+exponentiation — golden reference drand_tpu.crypto.pairing) in the
+batch-last layout of ops/bl.py, and wraps the heavy loops in Pallas
+kernels compiled by Mosaic — a different compiler path with per-kernel
+fusion instead of per-op dispatch:
+
+    K1  miller_kernel    — full 63-iteration Miller loop, both pairs
+    K2  easy_kernel      — f^((p^6-1)(p^2+1)) incl. the Fermat Fp inverse
+    K3  pow_kernel       — one cyclotomic pow-by-|e| chain (called 4x)
+
+Inter-kernel glue (Frobenius twists, f12 products, the final ==1 check)
+runs as plain XLA on the same bl arrays — a few hundred HLOs, negligible.
+
+Everything is also runnable WITHOUT Pallas (``use_pallas=False``): the
+math functions are pure jnp, so the CPU test suite validates them
+directly and the TPU engine known-answer-validates the kernels at every
+batch shape before trusting them (see ops/engine.py bucket validation).
+
+Reference hot calls replaced: chain/beacon/chain.go:136-141,
+client/verify.go:146-163, chain/beacon/node.go:112.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..crypto.fields import P, X_BLS
+from . import bl
+from . import limb as _limb
+from .bl import (
+    NLIMBS, DTYPE,
+    f2, f2_add, f2_sub, f2_neg, f2_mul, f2_sqr, f2_mul_fp, f2_mul_small,
+    f2_mul_by_xi, f12_mul, f12_sqr, f12_conj, f12_inv, f12_frobenius,
+    f12_cyclotomic_sqr, f12_one, f12_from_w, f12_to_w,
+    reduce_light,
+)
+
+# ---------------------------------------------------------------------------
+# Bit schedules (host constants, passed to kernels as inputs)
+# ---------------------------------------------------------------------------
+
+_X_ABS = abs(X_BLS)
+
+
+def _bits_2d(e: int, msb_skip_leading: bool) -> np.ndarray:
+    """MSB-first bit table padded to (1, 64) int32."""
+    s = bin(e)[2:]
+    if msb_skip_leading:
+        s = s[1:]
+    out = np.zeros((1, 64), dtype=np.int32)
+    out[0, :len(s)] = [int(c) for c in s]
+    return out
+
+
+MILLER_FLAGS = _bits_2d(_X_ABS, msb_skip_leading=True)   # 63 used
+N_MILLER = len(bin(_X_ABS)[3:])
+BITS_XM1 = _bits_2d(abs(X_BLS - 1), msb_skip_leading=False)  # 63 used
+N_XM1 = abs(X_BLS - 1).bit_length()
+BITS_X = _bits_2d(_X_ABS, msb_skip_leading=False)            # 64 used
+N_X = _X_ABS.bit_length()
+
+
+def value_bit_getter(bits2d):
+    """Bit getter over a traced (1, 64) value — XLA path only (Mosaic has
+    no dynamic_slice on values; kernels use smem_bit_getter)."""
+    def get(i):
+        return jax.lax.dynamic_slice(bits2d, (0, i), (1, 1))[0, 0]
+
+    return get
+
+
+def smem_bit_getter(bits_ref):
+    """Bit getter over a (1, 64) SMEM ref inside a Pallas kernel."""
+    def get(i):
+        return bits_ref[0, i]
+
+    return get
+
+
+# ---------------------------------------------------------------------------
+# Miller loop (batch-last). Shapes:
+#   xp, yp: (NP, 32, B) G1 affine coords per pair
+#   q:      (NP, 2, 2, 32, B) G2 affine (coord, c0/c1, limb, batch)
+#   f:      (2, 3, 2, 32, B)
+# The pair axis NP rides as a leading batch axis through all f2 ops.
+# ---------------------------------------------------------------------------
+
+def _dbl_step(T, xp, yp):
+    """Jacobian doubling + line (c0, c3, c5); see ops/pairing._dbl_step."""
+    X, Y, Z = T
+    X2 = f2_sqr(X)
+    Y2 = f2_sqr(Y)
+    Z2 = f2_sqr(Z)
+    Z3 = f2_mul(Z2, Z)
+    YZ3 = f2_mul(Y, Z3)
+    lam_s = f2_mul_small(f2_mul(X2, Z2), 3)
+    c0 = f2_mul_by_xi(f2_mul_fp(f2_mul_small(YZ3, 2), yp))
+    c5 = f2_neg(f2_mul_fp(lam_s, xp))
+    X3cu = f2_mul(X2, X)
+    c3 = f2_sub(f2_mul_small(X3cu, 3), f2_mul_small(Y2, 2))
+    C = f2_sqr(Y2)
+    D = f2_mul_small(f2_sub(f2_sqr(f2_add(X, Y2)), f2_add(X2, C)), 2)
+    E = f2_mul_small(X2, 3)
+    F = f2_sqr(E)
+    Xn = f2_sub(F, f2_mul_small(D, 2))
+    Yn = f2_sub(f2_mul(E, f2_sub(D, Xn)), f2_mul_small(C, 8))
+    Zn = f2_mul_small(f2_mul(Y, Z), 2)
+    return (Xn, Yn, Zn), (c0, c3, c5)
+
+
+def _add_step(T, q, xp, yp):
+    """Mixed addition + line; see ops/pairing._add_step."""
+    X, Y, Z = T
+    xq, yq = q[..., 0, :, :, :], q[..., 1, :, :, :]
+    Z2 = f2_sqr(Z)
+    Z3 = f2_mul(Z2, Z)
+    U2 = f2_mul(xq, Z2)
+    S2 = f2_mul(yq, Z3)
+    H = f2_sub(U2, X)
+    M = f2_sub(S2, Y)
+    HZ = f2_mul(H, Z)
+    c0 = f2_mul_by_xi(f2_mul_fp(HZ, yp))
+    c5 = f2_neg(f2_mul_fp(M, xp))
+    c3 = f2_sub(f2_mul(M, xq), f2_mul(HZ, yq))
+    HH = f2_sqr(H)
+    HHH = f2_mul(HH, H)
+    V = f2_mul(X, HH)
+    M2 = f2_sqr(M)
+    Xn = f2_sub(M2, f2_add(HHH, f2_mul_small(V, 2)))
+    Yn = f2_sub(f2_mul(M, f2_sub(V, Xn)), f2_mul(Y, HHH))
+    Zn = f2_mul(Z, H)
+    return (Xn, Yn, Zn), (c0, c3, c5)
+
+
+def _sparse_mul_035(f, lines, npairs: int):
+    """f * L_j for per-pair lines L = c0 + c3*w^3 + c5*w^5, folded in
+    sequentially. One stacked f2_mul per pair (slots from the M-twist
+    untwist — see ops/pairing._sparse_mul_035)."""
+    c0, c3, c5 = lines  # each (NP, 2, 32, B)
+    for j in range(npairs):
+        fw = f12_to_w(f)  # (6, 2, 32, B)
+        cj = jnp.stack([c0[j], c3[j], c5[j]], axis=0)  # (3, 2, 32, B)
+        prod = f2_mul(fw[None], cj[:, None])  # (3, 6, 2, 32, B)
+        p0, p3, p5 = prod[0], prod[1], prod[2]
+        out = []
+        for k in range(6):
+            term = p0[k]
+            t3 = p3[(k - 3) % 6]
+            if k - 3 < 0:
+                t3 = f2_mul_by_xi(t3)
+            t5 = p5[(k - 5) % 6]
+            if k - 5 < 0:
+                t5 = f2_mul_by_xi(t5)
+            out.append(reduce_light(term + t3 + t5))
+        f = f12_from_w(jnp.stack(out, axis=0))
+    return f
+
+
+def miller_loop_bl(xp, yp, q, flag_getter):
+    """Batched Miller loop, single fori_loop with masked add steps.
+
+    flag_getter(i) != 0 => mixed addition after doubling i (the set bits
+    of |x| after the implicit MSB). Conjugation for x < 0 is applied.
+    Returns f (2, 3, 2, 32, B).
+    """
+    npairs = q.shape[0]
+    b = q.shape[-1]
+    xq, yq = q[..., 0, :, :, :], q[..., 1, :, :, :]
+    # Z = 1 in Fp2, per pair — stacked build (no scatter in Mosaic)
+    one_fp = jnp.broadcast_to(bl._crow("ONE"),
+                              xq.shape[:-3] + (NLIMBS, b)).astype(DTYPE)
+    one2 = jnp.stack([one_fp, jnp.zeros_like(one_fp)], axis=-3)
+    f0 = f12_one((), b)
+
+    def body(i, state):
+        f, X, Y, Z = state
+        f = f12_sqr(f)
+        (X, Y, Z), lines = _dbl_step((X, Y, Z), xp, yp)
+        f = _sparse_mul_035(f, lines, npairs)
+        (Xa, Ya, Za), lines_a = _add_step((X, Y, Z), q, xp, yp)
+        fa = _sparse_mul_035(f, lines_a, npairs)
+        cond = flag_getter(i) != 0
+        f = jnp.where(cond, fa, f)
+        X = jnp.where(cond, Xa, X)
+        Y = jnp.where(cond, Ya, Y)
+        Z = jnp.where(cond, Za, Z)
+        return f, X, Y, Z
+
+    f, _, _, _ = jax.lax.fori_loop(0, N_MILLER, body, (f0, xq, yq, one2))
+    return f12_conj(f)  # x < 0
+
+
+# ---------------------------------------------------------------------------
+# Final exponentiation pieces
+# ---------------------------------------------------------------------------
+
+def final_exp_easy_bl(f, bit_getter=None):
+    """f^((p^6-1)(p^2+1)) — includes the single Fp Fermat inversion.
+    ``bit_getter`` feeds the p-2 exponent bits (kernels pass an SMEM-ref
+    getter; the XLA path defaults to the constant-buffer PM2 section)."""
+    f1 = f12_mul(f12_conj(f), f12_inv(f, bit_getter))
+    return f12_mul(f12_frobenius(f1, 2), f1)
+
+
+def cyc_pow_neg_bl(m, bit_getter, nbits: int):
+    """m^(-|e|) for cyclotomic m, MSB-first square-and-multiply."""
+    base = f12_conj(m)
+
+    def body(i, acc):
+        acc = f12_cyclotomic_sqr(acc)
+        return jnp.where(bit_getter(i) != 0, f12_mul(acc, base), acc)
+
+    init = f12_one((), m.shape[-1])
+    return jax.lax.fori_loop(0, nbits, body, init)
+
+
+def final_exp_hard_bl(m, g_xm1, g_x):
+    """Hayashida chain (cube of the canonical pairing — equality checks
+    are cube-invariant; mirrors ops/pairing._hard_part). g_xm1 / g_x are
+    bit getters for |x-1| and |x|."""
+    a1 = cyc_pow_neg_bl(m, g_xm1, N_XM1)
+    a2 = cyc_pow_neg_bl(a1, g_xm1, N_XM1)
+    a3 = f12_mul(cyc_pow_neg_bl(a2, g_x, N_X), f12_frobenius(a2, 1))
+    t = cyc_pow_neg_bl(a3, g_x, N_X)
+    a4 = f12_mul(f12_mul(cyc_pow_neg_bl(t, g_x, N_X),
+                         f12_frobenius(a3, 2)), f12_conj(a3))
+    return f12_mul(a4, f12_mul(m, f12_cyclotomic_sqr(m)))
+
+
+def final_exp_hard_is_one_bl(m, g_xm1, g_x):
+    """Hard part + ==1 check (per batch lane) — the finish kernel body."""
+    return bl.f12_is_one(final_exp_hard_bl(m, g_xm1, g_x))
+
+
+def final_exp_bl(f):
+    """Full (cubed) final exponentiation, pure jnp (no Pallas)."""
+    m = final_exp_easy_bl(f)
+    return final_exp_hard_bl(m, value_bit_getter(jnp.asarray(BITS_XM1)),
+                             value_bit_getter(jnp.asarray(BITS_X)))
+
+
+def multi_pairing_bl(xp, yp, q):
+    """prod_j e(P_j, Q_j) (cubed), pure jnp — the no-Pallas reference."""
+    return final_exp_bl(miller_loop_bl(
+        xp, yp, q, value_bit_getter(jnp.asarray(MILLER_FLAGS))))
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernels
+# ---------------------------------------------------------------------------
+
+def _pallas(kernel, out_shape, in_memspaces):
+    """pallas_call with per-input memory spaces: 'v' = VMEM tensor input,
+    's' = SMEM scalar table (bit schedules, read element-wise)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    spaces = {"v": pltpu.VMEM, "s": pltpu.SMEM}
+    return pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        in_specs=[pl.BlockSpec(memory_space=spaces[c])
+                  for c in in_memspaces],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    )
+
+
+def _miller_kernel(c_ref, flags_ref, xp_ref, yp_ref, q_ref, o_ref):
+    with bl.const_context(c_ref[:]):
+        o_ref[:] = miller_loop_bl(xp_ref[:], yp_ref[:], q_ref[:],
+                                  smem_bit_getter(flags_ref))
+
+
+def _easy_kernel(c_ref, pm2_ref, f_ref, o_ref):
+    with bl.const_context(c_ref[:]):
+        o_ref[:] = final_exp_easy_bl(
+            f_ref[:], bit_getter=smem_bit_getter(pm2_ref))
+
+
+def _pow_kernel(nbits: int, c_ref, bits_ref, m_ref, o_ref):
+    with bl.const_context(c_ref[:]):
+        o_ref[:] = cyc_pow_neg_bl(m_ref[:], smem_bit_getter(bits_ref),
+                                  nbits)
+
+
+# The XLA glue between kernels is NOT safe on the axon stack (the same
+# backend miscompile that breaks the batched XLA pairing graph corrupts
+# plain f12 glue ops at B >= ~16 — bisected 2026-07-30), so every
+# per-element operation after input packing stays inside Mosaic kernels.
+# The hard part is split into SMALL kernels: one fused kernel holds too
+# much live state for the 16 MB VMEM at B = 128.
+
+def _mul_frob1_kernel(c_ref, x_ref, y_ref, o_ref):
+    """out = x * frobenius(y, 1)."""
+    with bl.const_context(c_ref[:]):
+        o_ref[:] = f12_mul(x_ref[:], f12_frobenius(y_ref[:], 1))
+
+
+def _a4_kernel(c_ref, x_ref, y_ref, o_ref):
+    """out = x * frobenius(y, 2) * conj(y)."""
+    with bl.const_context(c_ref[:]):
+        o_ref[:] = f12_mul(f12_mul(x_ref[:], f12_frobenius(y_ref[:], 2)),
+                           f12_conj(y_ref[:]))
+
+
+def _is_one_kernel(c_ref, a4_ref, m_ref, o_ref):
+    """ok = (a4 * m * cyc_sqr(m) == 1); (8, B) int32 out, row 0 is read."""
+    with bl.const_context(c_ref[:]):
+        m = m_ref[:]
+        out = f12_mul(a4_ref[:], f12_mul(m, f12_cyclotomic_sqr(m)))
+        ok = bl.f12_is_one(out)
+        o_ref[:] = jnp.broadcast_to(ok.astype(DTYPE)[None, :], o_ref.shape)
+
+
+# p-2 bits as a flat (1, 384) MSB-first SMEM table for the easy kernel
+PM2_FLAT = bl._PM2_ROWS.reshape(1, 384)
+
+
+@functools.partial(jax.jit, static_argnames=("npairs", "b"))
+def _verify_pl(xp, yp, q, npairs: int, b: int):
+    """Full BLS batch check with ALL per-element math inside Pallas
+    kernels (miller -> easy -> pow chains -> glue -> is_one).
+    Returns (B,) bool."""
+    consts = jnp.asarray(bl.CONST_BUFFER)
+    f12_shape = jax.ShapeDtypeStruct((2, 3, 2, NLIMBS, b), DTYPE)
+
+    f = _pallas(_miller_kernel, f12_shape, "vsvvv")(
+        consts, jnp.asarray(MILLER_FLAGS), xp, yp, q)
+    m = _pallas(_easy_kernel, f12_shape, "vsv")(
+        consts, jnp.asarray(PM2_FLAT), f)
+
+    def pow_neg(x, bits2d, nbits):
+        return _pallas(functools.partial(_pow_kernel, nbits),
+                       f12_shape, "vsv")(consts, jnp.asarray(bits2d), x)
+
+    a1 = pow_neg(m, BITS_XM1, N_XM1)
+    a2 = pow_neg(a1, BITS_XM1, N_XM1)
+    a3 = _pallas(_mul_frob1_kernel, f12_shape, "vvv")(
+        consts, pow_neg(a2, BITS_X, N_X), a2)
+    t = pow_neg(a3, BITS_X, N_X)
+    a4 = _pallas(_a4_kernel, f12_shape, "vvv")(
+        consts, pow_neg(t, BITS_X, N_X), a3)
+    ok = _pallas(_is_one_kernel, jax.ShapeDtypeStruct((8, b), DTYPE),
+                 "vvv")(consts, a4, m)
+    return ok[0] != 0
+
+
+# ---------------------------------------------------------------------------
+# Verification entry points
+# ---------------------------------------------------------------------------
+
+def _f12_is_one_bl(f):
+    """==1 check in XLA: transpose to the limb-last layout and reuse the
+    proven exact-normalize comparison from ops/limb."""
+    from . import tower as _tw
+
+    g = jnp.moveaxis(f, -1, 0)  # (B, 2, 3, 2, 32)
+    d = _limb.sub(g, _tw.f12_one())
+    z = _limb.is_zero_mod_p(d)  # (B, 2, 3, 2)
+    return jnp.all(z, axis=(-3, -2, -1))
+
+
+def pack_verify_inputs(pub_aff, sig_aff, msg_aff):
+    """Batch-leading engine arrays -> batch-last kernel arrays.
+
+    pub_aff (B, 2, 32), sig_aff/msg_aff (B, 2, 2, 32) — the layout of
+    ops/engine._run_bucket — become xp/yp (2, 32, B) and q (2, 2, 2, 32, B)
+    with pair 0 = (-g1, sig) and pair 1 = (pub, msg).
+    """
+    neg_g1 = np.broadcast_to(_neg_g1_np(), pub_aff.shape)
+    xp = jnp.stack([jnp.moveaxis(jnp.asarray(neg_g1[:, 0]), 0, -1),
+                    jnp.moveaxis(jnp.asarray(pub_aff[:, 0]), 0, -1)])
+    yp = jnp.stack([jnp.moveaxis(jnp.asarray(neg_g1[:, 1]), 0, -1),
+                    jnp.moveaxis(jnp.asarray(pub_aff[:, 1]), 0, -1)])
+    q = jnp.stack([jnp.moveaxis(jnp.asarray(sig_aff), 0, -1),
+                   jnp.moveaxis(jnp.asarray(msg_aff), 0, -1)])
+    return xp, yp, q
+
+
+_NEG_G1_NP = None
+
+
+def _neg_g1_np():
+    global _NEG_G1_NP
+    if _NEG_G1_NP is None:
+        from ..crypto.curves import PointG1
+
+        x, y = (-PointG1.generator()).to_affine()
+        _NEG_G1_NP = np.stack([_limb.int_to_mont_limbs(x.v),
+                               _limb.int_to_mont_limbs(y.v)])
+    return _NEG_G1_NP
+
+
+def verify_prepared_pl(pub_aff, sig_aff, msg_aff, use_pallas: bool = True):
+    """Batched BLS verify — same contract as ops/pairing.verify_prepared
+    (e(-g1, sig) * e(pub, H(msg)) == 1 per batch row) on the batch-last
+    Pallas path. Inputs in the engine's batch-leading layout."""
+    xp, yp, q = pack_verify_inputs(np.asarray(pub_aff), np.asarray(sig_aff),
+                                   np.asarray(msg_aff))
+    b = q.shape[-1]
+    if use_pallas:
+        return _verify_pl(xp, yp, q, npairs=2, b=b)
+    return _f12_is_one_bl(_multi_pairing_jit(xp, yp, q))
+
+
+@jax.jit
+def _multi_pairing_jit(xp, yp, q):
+    return multi_pairing_bl(xp, yp, q)

@@ -162,3 +162,26 @@ def test_f2_mul_inside_pallas_kernel_interpret():
         interpret=True,
     )(jnp.asarray(bl.CONST_BUFFER), a, b)
     assert unpack_f2(out) == [x * y for x, y in zip(xs, ys)]
+
+
+def test_exact_zero_tests():
+    xs = rand_fp_ints()
+    a = jnp.asarray(bl.pack_fp(xs))
+    assert not np.asarray(bl.is_zero_mod_p(a)).any()
+    # a - a is a non-canonical representation of 0 (mod p)
+    z = bl.sub(a, a)
+    assert np.asarray(bl.is_zero_mod_p(z)).all()
+    # a + (-a) likewise
+    z2 = bl.add(a, bl.neg(a))
+    assert np.asarray(bl.is_zero_mod_p(z2)).all()
+
+
+def test_f12_is_one():
+    one = bl.f12_one((), B)
+    assert np.asarray(bl.f12_is_one(one)).all()
+    xs = rand_f12()
+    a = jnp.asarray(pack_f12(xs))
+    assert not np.asarray(bl.f12_is_one(a)).any()
+    # one * x * x^-1 == one exercises the full mul/inv pipeline
+    prod = bl.f12_mul(a, bl.f12_inv(a))
+    assert np.asarray(bl.f12_is_one(prod)).all()
