@@ -111,12 +111,35 @@ _CONST_SECTIONS = [
     ("PMULT_LO", _PMULT_33[:, :NLIMBS].astype(np.int32)),
 ]
 _OFFSETS: dict[str, tuple[int, int]] = {}
-_off = 0
-for _name, _rows in _CONST_SECTIONS:
-    _OFFSETS[_name] = (_off, _rows.shape[0])
-    _off += _rows.shape[0]
-CONST_BUFFER = np.concatenate([r for _, r in _CONST_SECTIONS], axis=0)
-CONST_BUFFER.setflags(write=False)
+
+
+def _rebuild_buffer() -> None:
+    global CONST_BUFFER
+    _OFFSETS.clear()
+    off = 0
+    for name, rows in _CONST_SECTIONS:
+        _OFFSETS[name] = (off, rows.shape[0])
+        off += rows.shape[0]
+    CONST_BUFFER = np.concatenate([r for _, r in _CONST_SECTIONS], axis=0)
+    CONST_BUFFER.setflags(write=False)
+
+
+def register_consts(sections: list[tuple[str, np.ndarray]]) -> None:
+    """Append constant sections (name, (n, 32) int32 rows) — used by
+    bl_curve/bl_h2c at import, BEFORE any kernel compiles (the buffer is
+    re-snapshot at every kernel call, so order of registration only has
+    to be deterministic across processes for the compile cache)."""
+    known = {n for n, _ in _CONST_SECTIONS}
+    for name, rows in sections:
+        if name in known:
+            raise ValueError(f"duplicate const section {name!r}")
+        if rows.ndim != 2 or rows.shape[1] != NLIMBS:
+            raise ValueError(f"section {name!r} must be (n, {NLIMBS})")
+        _CONST_SECTIONS.append((name, rows.astype(np.int32)))
+    _rebuild_buffer()
+
+
+_rebuild_buffer()
 
 _ACTIVE_BUF = None
 
@@ -141,16 +164,33 @@ def _cbuf():
 
 
 def _crow(name: str):
-    """(32, 1) column for single-row constants."""
+    """Single-row constant: (32, 1) column from a (K, 32) buffer, or
+    (32, B) lanes from a (K, 32, B) lane-broadcast buffer (kernels whose
+    constants reach the convolution use the latter — Mosaic cannot
+    dual-broadcast a (…, 1, 1) slice)."""
     off, n = _OFFSETS[name]
     assert n == 1, name
-    return _cbuf()[off][:, None]
+    row = _cbuf()[off]
+    return row[:, None] if row.ndim == 1 else row
 
 
 def _csec(name: str):
-    """(n, 32) section."""
+    """(n, 32) or (n, 32, B) section."""
     off, n = _OFFSETS[name]
     return _cbuf()[off:off + n]
+
+
+def _colrow(row):
+    """A section row -> broadcastable column: (32,) -> (32, 1); a
+    lane-ful (32, B) row passes through."""
+    return row[:, None] if row.ndim == 1 else row
+
+
+def lane_buffer(b: int) -> np.ndarray:
+    """The (K, 32, b) lane-broadcast const buffer (host numpy) — pass as
+    the const input of kernels whose constants reach the convolution."""
+    return np.broadcast_to(CONST_BUFFER[:, :, None],
+                           CONST_BUFFER.shape + (b,))
 
 
 def one_mont(shape_prefix, b):
@@ -193,7 +233,7 @@ def _wrap(t, passes: int, fold_rounds: int = 3):
         wrap_rows = _csec("WRAP")
         red = jnp.zeros_like(lo)
         for i in range(k):
-            row = wrap_rows[i][:, None]  # (32, 1)
+            row = _colrow(wrap_rows[i])
             red = red + hi[..., i:i + 1, :] * row
         t = _fold(lo + red, rounds=fold_rounds, grow=True)
     return t[..., :NLIMBS, :]
@@ -271,7 +311,13 @@ def _conv(a, b, out_len: int):
 
 def mont_mul(a, b):
     """Montgomery product a * b * R^-1 mod p (REDC) — see limb.mont_mul for
-    the quotient-bit argument. Identical algorithm, batch-last layout."""
+    the quotient-bit argument. Identical algorithm, batch-last layout.
+
+    Constant-column operands ((…, 32, 1)) are fine in XLA; kernels whose
+    constants reach this convolution must use a LANE-BROADCAST const
+    buffer (const_context with a (K, 32, B) buffer — bl.lane_buffer):
+    a (…, 1, 1) slice times a full window would need a both-sublanes-
+    and-lanes vector broadcast, which Mosaic cannot lower."""
     t = _conv(a, b, 2 * NLIMBS)                     # (..., 64, B)
     t = _fold(t, rounds=3, grow=True)               # (..., 65, B)
     m = _conv(t[..., :NLIMBS, :], jnp.broadcast_to(
@@ -481,8 +527,11 @@ def f12_frobenius(a, power: int = 1):
     w = f12_to_w(a)
     if power % 2 == 1:
         w = f2_conj(w)
-    # (6, 2, 32, 1) — broadcasts over batch lanes
-    gam = _csec(f"GAMMA{power}").reshape(6, 2, NLIMBS)[..., None]
+    sec = _csec(f"GAMMA{power}")
+    if sec.ndim == 2:   # (12, 32) -> (6, 2, 32, 1)
+        gam = sec.reshape(6, 2, NLIMBS)[..., None]
+    else:               # (12, 32, B) lane-ful -> (6, 2, 32, B)
+        gam = sec.reshape(6, 2, NLIMBS, sec.shape[-1])
     return f12_from_w(f2_mul(w, gam))
 
 
@@ -601,7 +650,8 @@ def is_zero_mod_p(a):
     lo = _csec("PMULT_LO")     # (K, 32)
     eqs = []
     for k in range(N_PMULT):
-        ok_lo = jnp.all(norm[..., :NLIMBS, :] == lo[k][:, None], axis=-2)
+        ok_lo = jnp.all(norm[..., :NLIMBS, :] == _colrow(lo[k]),
+                        axis=-2)
         # top limb vs a PYTHON INT scalar — a (1,1)-vector comparison would
         # need a both-sublanes-and-lanes broadcast, which Mosaic lacks
         ok_hi = norm[..., NLIMBS, :] == int(_PMULT_33[k, NLIMBS])

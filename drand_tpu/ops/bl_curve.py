@@ -1,0 +1,235 @@
+"""Batch-last G2 point arithmetic + ψ fast paths for Pallas kernels.
+
+Reuses the generic branch-free Jacobian formulas of ops/curve.py (pt_dbl,
+pt_add, pt_select, …) through a batch-last Fp2 namespace: a point is
+(X, Y, Z, inf) with X/Y/Z shaped (..., 2, 32, B) and inf (..., B).
+
+Adds the two scalar-heavy G2 operations the wire-prep pipeline needs, in
+their ψ-endomorphism fast forms (host oracle: crypto/endo.py, which
+probes and validates the constants at import):
+
+- ``subgroup_check``: ψ(Q) == [x]Q — one 64-bit double-and-add chain
+  (hamming weight 6) instead of a 255-bit [r]Q chain;
+- ``clear_cofactor``: Budroni-Pintore
+  [x²−x−1]P + ψ([x−1]P) + ψ²([2]P) — two nested [x]-chains instead of
+  one 636-bit [h_eff] chain.
+
+Scalar-multiplication bit schedules come from bit getters (SMEM refs in
+kernels, traced values in the XLA/CPU test path), like ops/pallas_pairing.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..crypto import endo
+from ..crypto.fields import X_BLS
+from . import bl
+from . import curve as xc  # the generic (F-parametric) point formulas
+from . import limb as _limb
+from .bl import DTYPE, NLIMBS
+
+
+def _f2_rows(x) -> np.ndarray:
+    """Host Fp2 -> 2 mont-limb rows for the const buffer."""
+    return np.stack([_limb.int_to_mont_limbs(x.c0),
+                     _limb.int_to_mont_limbs(x.c1)])
+
+
+bl.register_consts([
+    ("PSI_CX", _f2_rows(endo.PSI_CX)),
+    ("PSI_CY", _f2_rows(endo.PSI_CY)),
+    ("PSI2_CX", _f2_rows(endo.PSI2_CX)),
+    ("PSI2_CY", _f2_rows(endo.PSI2_CY)),
+])
+
+
+def _csec_f2(name: str):
+    """Fp2 const: (2, 32, 1) column from a (K, 32) buffer, (2, 32, B)
+    from a lane-broadcast (K, 32, B) kernel buffer."""
+    sec = bl._csec(name)
+    return sec[..., None] if sec.ndim == 2 else sec
+
+
+# ---------------------------------------------------------------------------
+# Batch-last Fp2 namespace for ops/curve's generic formulas
+# ---------------------------------------------------------------------------
+
+def _sel(cond, a, b):
+    cond = jnp.asarray(cond)
+    if cond.ndim == 0:
+        return jnp.where(cond, a, b)
+    return jnp.where(cond[..., None, None, :], a, b)
+
+
+def make_f2(inv_bit_getter=None) -> SimpleNamespace:
+    """The namespace; ``inv_bit_getter`` feeds the Fermat-inverse exponent
+    bits (kernels pass an SMEM getter — the default dynamic-slice getter
+    does not lower in Mosaic)."""
+
+    def inv(a):
+        return bl.f2_inv(a, inv_bit_getter)
+
+    return SimpleNamespace(
+        name="fp2-bl",
+        add=bl.f2_add,
+        sub=bl.f2_sub,
+        neg=bl.f2_neg,
+        mul=bl.f2_mul,
+        sqr=bl.f2_sqr,
+        mul_small=bl.f2_mul_small,
+        inv=inv,
+        select=_sel,
+        is_zero=lambda a: (bl.is_zero_mod_p(a[..., 0, :, :])
+                           & bl.is_zero_mod_p(a[..., 1, :, :])),
+        zero=lambda bs: jnp.zeros(bs[:-1] + (2, NLIMBS) + bs[-1:], DTYPE),
+        one=lambda bs: jnp.broadcast_to(
+            jnp.stack([bl._crow("ONE"),
+                       jnp.zeros_like(bl._crow("ONE"))], axis=0),
+            bs[:-1] + (2, NLIMBS) + bs[-1:]).astype(DTYPE),
+        elem_ndim=2,
+    )
+
+
+F2 = make_f2()  # XLA/CPU-path namespace (kernel paths build their own)
+
+
+# ---------------------------------------------------------------------------
+# ψ endomorphism (Jacobian: ψ(X, Y, Z) = (cx·X̄, cy·Ȳ, Z̄) — no inversion)
+# ---------------------------------------------------------------------------
+
+def psi(p):
+    X, Y, Z, inf = p
+    return (bl.f2_mul(bl.f2_conj(X), _csec_f2("PSI_CX")),
+            bl.f2_mul(bl.f2_conj(Y), _csec_f2("PSI_CY")),
+            bl.f2_conj(Z), inf)
+
+
+def psi2(p):
+    X, Y, Z, inf = p
+    return (bl.f2_mul(X, _csec_f2("PSI2_CX")),
+            bl.f2_mul(Y, _csec_f2("PSI2_CY")), Z, inf)
+
+
+# ---------------------------------------------------------------------------
+# Scalar multiplication by |x| (bit-getter driven) and the fast paths
+# ---------------------------------------------------------------------------
+
+_X_ABS = abs(X_BLS)
+X_BITS = np.zeros((1, 64), dtype=np.int32)
+X_BITS[0, :_X_ABS.bit_length()] = [int(c) for c in bin(_X_ABS)[2:]]
+N_XBITS = _X_ABS.bit_length()
+
+
+def pt_mul_bits_getter(F, p, bit_getter, nbits: int):
+    """MSB-first double-and-add with masked adds (fori_loop body).
+
+    The infinity mask crosses loop iterations as INT32: a 1-D bool carry
+    lowers through an i8 Mosaic buffer whose i8->i1 truncation is
+    unsupported ("Unsupported target bitwidth for truncation")."""
+    batch = p[3].shape
+
+    def body(i, state):
+        X, Y, Z, inf32 = state
+        acc = xc.pt_dbl(F, (X, Y, Z, inf32 != 0))
+        wa = xc.pt_add(F, acc, p)
+        # scalar cond (uniform across lanes): broadcasting an i1 scalar to
+        # a 1-D lane vector materializes an i8 buffer whose i1 truncation
+        # Mosaic cannot lower
+        cond = bit_getter(i) != 0
+        out = xc.pt_select(F, cond, wa, acc)
+        return out[0], out[1], out[2], jnp.where(out[3], 1, 0)
+
+    init = (F.one(batch), F.one(batch), F.zero(batch),
+            jnp.ones(batch, DTYPE))  # int mask: no constant-bool splats
+    out = jax.lax.fori_loop(0, nbits, body, init)
+    return out[0], out[1], out[2], out[3] != 0
+
+
+def mul_x(F, p, x_bit_getter):
+    """[x]P (x = X_BLS < 0): [|x|]P then negate."""
+    return xc.pt_neg(F, pt_mul_bits_getter(F, p, x_bit_getter, N_XBITS))
+
+
+def subgroup_check(F, q, x_bit_getter):
+    """ψ(Q) == [x]Q per batch lane (Scott; host oracle
+    endo.subgroup_check_fast). Infinity counts as a member."""
+    lhs = psi(q)
+    rhs = mul_x(F, q, x_bit_getter)
+    # Jacobian equality: X1·Z2² == X2·Z1² and Y1·Z2³ == Y2·Z1³
+    z1s, z2s = bl.f2_sqr(lhs[2]), bl.f2_sqr(rhs[2])
+    ex = F.is_zero(bl.f2_sub(bl.f2_mul(lhs[0], z2s),
+                             bl.f2_mul(rhs[0], z1s)))
+    z1c, z2c = bl.f2_mul(z1s, lhs[2]), bl.f2_mul(z2s, rhs[2])
+    ey = F.is_zero(bl.f2_sub(bl.f2_mul(lhs[1], z2c),
+                             bl.f2_mul(rhs[1], z1c)))
+    both = ex & ey & ~lhs[3] & ~rhs[3]
+    return both | (lhs[3] & rhs[3]) | q[3]
+
+
+def clear_cofactor(F, p, x_bit_getter):
+    """[h_eff]P via Budroni-Pintore (host oracle endo.clear_cofactor_fast):
+    [x²−x−1]P + ψ([x−1]P) + ψ²([2]P), with the [x]-chains as bit-getter
+    double-and-adds."""
+    t1 = mul_x(F, p, x_bit_getter)                       # [x]P
+    t2 = mul_x(F, t1, x_bit_getter)                      # [x²]P
+    part1 = xc.pt_add(F, xc.pt_add(F, t2, xc.pt_neg(F, t1)),
+                      xc.pt_neg(F, p))                   # [x²−x−1]P
+    part2 = psi(xc.pt_add(F, t1, xc.pt_neg(F, p)))       # ψ([x−1]P)
+    part3 = psi2(xc.pt_dbl(F, p))                        # ψ²([2]P)
+    return xc.pt_add(F, xc.pt_add(F, part1, part2), part3)
+
+
+# ---------------------------------------------------------------------------
+# Host <-> batch-last packing (tests, engine prep)
+# ---------------------------------------------------------------------------
+
+def pack_g2_points(points) -> tuple:
+    """list[PointG2] -> batch-last device point (2, 32, B) coords."""
+    import numpy as _np
+
+    n = len(points)
+    X = _np.zeros((2, NLIMBS, n), _np.int32)
+    Y = _np.zeros((2, NLIMBS, n), _np.int32)
+    Z = _np.zeros((2, NLIMBS, n), _np.int32)
+    inf = _np.zeros(n, bool)
+    for j, p in enumerate(points):
+        if p.is_infinity():
+            inf[j] = True
+            X[0, :, j] = _np.asarray(_limb.ONE_MONT)
+            Y[0, :, j] = _np.asarray(_limb.ONE_MONT)
+            continue
+        x, y = p.to_affine()
+        X[0, :, j] = _limb.int_to_mont_limbs(x.c0)
+        X[1, :, j] = _limb.int_to_mont_limbs(x.c1)
+        Y[0, :, j] = _limb.int_to_mont_limbs(y.c0)
+        Y[1, :, j] = _limb.int_to_mont_limbs(y.c1)
+        Z[0, :, j] = _np.asarray(_limb.ONE_MONT)
+    return (jnp.asarray(X), jnp.asarray(Y), jnp.asarray(Z),
+            jnp.asarray(inf))
+
+
+def unpack_g2_points(pt) -> list:
+    """Batch-last device point -> list[PointG2]."""
+    from ..crypto.curves import PointG2
+    from ..crypto.fields import Fp2
+
+    X, Y, Z, inf = (np.asarray(t) for t in pt)
+    out = []
+    for j in range(inf.shape[-1]):
+        if inf[..., j]:
+            out.append(PointG2.infinity())
+            continue
+        out.append(PointG2(
+            Fp2(_limb.fp_from_device(X[0, :, j]),
+                _limb.fp_from_device(X[1, :, j])),
+            Fp2(_limb.fp_from_device(Y[0, :, j]),
+                _limb.fp_from_device(Y[1, :, j])),
+            Fp2(_limb.fp_from_device(Z[0, :, j]),
+                _limb.fp_from_device(Z[1, :, j])),
+        ))
+    return out

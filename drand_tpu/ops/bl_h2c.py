@@ -1,0 +1,268 @@
+"""Batch-last hash-to-G2 + G2 decompression — wire-prep for the Pallas
+verification path.
+
+Host-side preparation (pure-Python hash_to_g2 ~45ms/message, subgroup-
+checked decompression ~18ms/signature) caps end-to-end catch-up at ~15
+beacons/s no matter how fast the pairing kernels are. This module ports
+the device pipeline of ops/h2c.py to the batch-last layout so it can run
+inside Mosaic kernels next to the pairing chain, with two algorithmic
+upgrades over the XLA version:
+
+- cofactor clearing via Budroni-Pintore ψ-composition (bl_curve.clear_
+  cofactor): two 64-bit [x]-chains instead of one 636-bit [h_eff] chain;
+- subgroup membership via Scott's ψ(Q) == [x]Q (bl_curve.subgroup_check)
+  instead of a 255-bit [r]Q chain.
+
+Only SHA-256 message expansion and signature byte-splitting stay on the
+host (ops/h2c.msgs_to_u / sigs_to_x, transposed to batch-last here).
+
+Mirrors drand_tpu.crypto.hash_to_curve (RFC 9380) and
+crypto.curves.PointG2.from_bytes; golden tests: tests/test_bl_h2c.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..crypto.fields import P
+from ..crypto.hash_to_curve import (
+    _A_PRIME, _B_PRIME, _B_OVER_ZA, _ISO_PARAMS, _MINUS_B_OVER_A, _Z_SSWU,
+)
+from ..crypto.fields import _FP2_ROOTS_OF_UNITY_4
+from . import bl, bl_curve as blc
+from . import limb as _limb
+from .bl import DTYPE, MASK, NLIMBS
+from .bl_curve import _csec_f2
+
+
+def _f2_rows(x) -> np.ndarray:
+    return np.stack([_limb.int_to_mont_limbs(x.c0),
+                     _limb.int_to_mont_limbs(x.c1)])
+
+
+_X0, _V_SUM, _U_SUM, _C2, _C3 = _ISO_PARAMS
+_B_G2_F2 = type(_A_PRIME)(4, 4)
+
+bl.register_consts([
+    ("SSWU_A", _f2_rows(_A_PRIME)),
+    ("SSWU_B", _f2_rows(_B_PRIME)),
+    ("SSWU_Z", _f2_rows(_Z_SSWU)),
+    ("SSWU_MBA", _f2_rows(_MINUS_B_OVER_A)),
+    ("SSWU_BZA", _f2_rows(_B_OVER_ZA)),
+    ("ISO_X0", _f2_rows(_X0)),
+    ("ISO_VSUM", _f2_rows(_V_SUM)),
+    ("ISO_USUM", _f2_rows(_U_SUM)),
+    ("ISO_C2", _f2_rows(_C2)),
+    ("ISO_C3", _f2_rows(_C3)),
+    ("B_G2", _f2_rows(_B_G2_F2)),
+    ("ROOTS4", np.concatenate([_f2_rows(r) for r in _FP2_ROOTS_OF_UNITY_4])),
+    ("RAW1", _limb.int_to_limbs(1)[None, :]),
+])
+
+# sqrt exponent (q = p^2 ≡ 9 mod 16): candidate a^((q+7)/16), then a 4th
+# root of unity correction. MSB-first bits, padded to (1, 768).
+_SQRT_EXP = (P * P + 7) // 16
+SQRT_NBITS = _SQRT_EXP.bit_length()
+SQRT_BITS = np.zeros((1, 768), dtype=np.int32)
+SQRT_BITS[0, :SQRT_NBITS] = [int(c) for c in bin(_SQRT_EXP)[2:]]
+
+
+# ---------------------------------------------------------------------------
+# Field helpers
+# ---------------------------------------------------------------------------
+
+def f2_pow_getter(a, bit_getter, nbits: int):
+    """a^e, MSB-first square-and-multiply, bits via getter."""
+
+    def body(i, acc):
+        acc = bl.f2_sqr(acc)
+        return jnp.where(bit_getter(i) != 0, bl.f2_mul(acc, a), acc)
+
+    init = jnp.broadcast_to(
+        jnp.stack([jnp.broadcast_to(bl._crow("ONE"), a.shape[-2:]),
+                   jnp.zeros(a.shape[-2:], DTYPE)], axis=0), a.shape
+    ).astype(DTYPE)
+    return jax.lax.fori_loop(0, nbits, body, init)
+
+
+def sqrt_f2(a, sqrt_bit_getter):
+    """(root, is_square): candidate exponentiation + 4th-root-of-unity
+    correction (mirrors ops/h2c._sqrt_f2)."""
+    cand = f2_pow_getter(a, sqrt_bit_getter, SQRT_NBITS)
+    sec = bl._csec("ROOTS4")
+    if sec.ndim == 2:
+        roots = sec.reshape(4, 2, NLIMBS)[..., None]
+    else:
+        roots = sec.reshape(4, 2, NLIMBS, sec.shape[-1])
+    best, found = None, None
+    for i in range(4):
+        r = bl.f2_mul(cand, roots[i])
+        d = bl.sub(bl.f2_sqr(r), a)
+        ok = bl.is_zero_mod_p(d[..., 0, :, :]) & bl.is_zero_mod_p(
+            d[..., 1, :, :])
+        if best is None:
+            best, found = r, ok
+        else:
+            best = blc._sel(ok, r, best)
+            found = found | ok
+    return best, found
+
+
+def from_mont(a):
+    """Montgomery -> raw limbs (value mod p, engine invariant)."""
+    return bl.mont_mul(a, jnp.broadcast_to(bl._crow("RAW1"), a.shape))
+
+
+def _lex_ge_rows(a, b):
+    """a >= b lexicographically for exact limb stacks (..., L, B) vs
+    (..., L, B): MSB (highest row) decides. Static unroll over L."""
+    L = a.shape[-2]
+    # Mosaic-safe formulation: no constant bool vectors (an i1 splat
+    # lowers through an unsupported i8 truncation) and no selects on
+    # i1-typed BRANCHES (same i8 path) — the running state is INT32 0/1
+    top = L - 1
+    ge = jnp.where(a[..., top, :] >= b[..., top, :], 1, 0)
+    decided = jnp.where(a[..., top, :] != b[..., top, :], 1, 0)
+    for i in range(L - 2, -1, -1):
+        ai, bi = a[..., i, :], b[..., i, :]
+        gt = jnp.where(ai > bi, 1, 0)
+        eq = jnp.where(ai == bi, 1, 0)
+        ge = jnp.where(decided != 0, ge, gt | (eq & ge))
+        decided = decided | (1 - eq)
+    return ge != 0
+
+
+def canonicalize(a):
+    """Exact canonical limbs of (value mod p): (..., 32, B), each limb in
+    [0, MASK]. Static port of limb.canonicalize (select the right multiple
+    of p, subtract with a borrow chain)."""
+    norm = bl.exact_normalize(a)  # (..., 33, B) exact, value < ~2^385
+    lo = bl._csec("PMULT_LO")     # (K, 32)
+    K = bl.N_PMULT
+    # count multiples <= value -> k index, then build the chosen multiple
+    ge_ks = []
+    for k in range(K):
+        row = bl._colrow(lo[k])
+        top = jnp.full_like(row[:1], int(bl._PMULT_33[k, NLIMBS]))
+        mult_col = jnp.concatenate([row, top], axis=0)
+        ge_ks.append(_lex_ge_rows(norm, mult_col))
+    # stack as INT32 — concatenating i1 vectors hits an invalid
+    # vreg bitcast in Mosaic
+    ge = jnp.stack([jnp.where(g, 1, 0) for g in ge_ks], axis=0)
+    kidx = jnp.sum(ge, axis=0) - 1          # (..., B)
+    chosen = jnp.zeros_like(norm)
+    for k in range(K):
+        onehot = (kidx == k)
+        row = bl._colrow(lo[k])
+        top = jnp.full_like(row[:1], int(bl._PMULT_33[k, NLIMBS]))
+        mult_col = jnp.concatenate([row, top], axis=0)
+        chosen = chosen + jnp.where(onehot[..., None, :], mult_col, 0)
+    diff = norm - chosen
+    # borrow chain, static 33 steps
+    rows = [diff[..., i, :] for i in range(diff.shape[-2])]
+    out = []
+    carry = jnp.zeros_like(rows[0])
+    for i in range(len(rows)):
+        s = rows[i] + carry
+        out.append(s & MASK)
+        carry = s >> bl.BITS
+    return jnp.stack(out[:NLIMBS], axis=-2)
+
+
+def sgn0_f2(a):
+    """RFC 9380 sgn0 for Fp2 on canonical limbs; (..., B) bool."""
+    c0 = canonicalize(from_mont(a[..., 0, :, :]))
+    c1 = canonicalize(from_mont(a[..., 1, :, :]))
+    sign0 = (c0[..., 0, :] & 1) != 0
+    zero0 = jnp.all(c0 == 0, axis=-2)
+    sign1 = (c1[..., 0, :] & 1) != 0
+    return sign0 | (zero0 & sign1)
+
+
+def lex_largest_f2(y):
+    """zcash sign rule: y lexicographically larger than -y (compare c1
+    then c0 on canonical limbs)."""
+    yc0 = canonicalize(from_mont(y[..., 0, :, :]))
+    yc1 = canonicalize(from_mont(y[..., 1, :, :]))
+    ny = bl.f2_neg(y)
+    nc0 = canonicalize(from_mont(ny[..., 0, :, :]))
+    nc1 = canonicalize(from_mont(ny[..., 1, :, :]))
+    c1_eq = jnp.all(yc1 == nc1, axis=-2)
+    c1_gt = _lex_ge_rows(yc1, nc1) & ~c1_eq
+    c0_gt = _lex_ge_rows(yc0, nc0) & ~jnp.all(yc0 == nc0, axis=-2)
+    return c1_gt | (c1_eq & c0_gt)
+
+
+# ---------------------------------------------------------------------------
+# SSWU + isogeny (port of ops/h2c.map_to_curve_g2, batch-last)
+# ---------------------------------------------------------------------------
+
+def map_to_curve(u, sqrt_bit_getter, inv_bit_getter=None):
+    """u: (..., 2, 32, B) Fp2 mont -> affine (x, y) on E2 pre-clearing."""
+    a_p = _csec_f2("SSWU_A")
+    b_p = _csec_f2("SSWU_B")
+    zu2 = bl.f2_mul(_csec_f2("SSWU_Z"), bl.f2_sqr(u))
+    tv = bl.f2_add(bl.f2_sqr(zu2), zu2)
+    tv_zero = bl.is_zero_mod_p(tv[..., 0, :, :]) & bl.is_zero_mod_p(
+        tv[..., 1, :, :])
+    one = blc.make_f2().one(u.shape[:-3] + (u.shape[-1],)) + u * 0
+    x1_main = bl.f2_mul(_csec_f2("SSWU_MBA"),
+                        bl.f2_add(one, bl.f2_inv(tv, inv_bit_getter)))
+    x1 = blc._sel(tv_zero,
+                  jnp.broadcast_to(_csec_f2("SSWU_BZA"), x1_main.shape),
+                  x1_main)
+
+    def g_prime(x):
+        return bl.f2_add(bl.f2_add(bl.f2_mul(bl.f2_sqr(x), x),
+                                   bl.f2_mul(a_p, x)), b_p)
+
+    gx1 = g_prime(x1)
+    y1, sq1 = sqrt_f2(gx1, sqrt_bit_getter)
+    x2 = bl.f2_mul(zu2, x1)
+    gx2 = g_prime(x2)
+    y2, _ = sqrt_f2(gx2, sqrt_bit_getter)
+    x = blc._sel(sq1, x1, x2)
+    y = blc._sel(sq1, y1, y2)
+    flip = sgn0_f2(u) != sgn0_f2(y)
+    y = blc._sel(flip, bl.f2_neg(y), y)
+    # 3-isogeny + isomorphism onto E2
+    d = bl.f2_sub(x, _csec_f2("ISO_X0"))
+    dinv = bl.f2_inv(d, inv_bit_getter)
+    dinv2 = bl.f2_sqr(dinv)
+    X = bl.f2_add(x, bl.f2_add(bl.f2_mul(_csec_f2("ISO_VSUM"), dinv),
+                               bl.f2_mul(_csec_f2("ISO_USUM"), dinv2)))
+    Y = bl.f2_mul(y, bl.f2_sub(one, bl.f2_add(
+        bl.f2_mul(_csec_f2("ISO_VSUM"), dinv2),
+        bl.f2_mul(bl.f2_mul_small(_csec_f2("ISO_USUM"), 2),
+                  bl.f2_mul(dinv2, dinv)))))
+    return bl.f2_mul(_csec_f2("ISO_C2"), X), bl.f2_mul(_csec_f2("ISO_C3"), Y)
+
+
+def hash_to_g2_bl(u_pairs, F, sqrt_bit_getter, x_bit_getter,
+                  inv_bit_getter=None):
+    """u_pairs: (2, 2, 32, B) — two Fp2 u-values per message. Returns the
+    r-order G2 point (Jacobian, batch-last)."""
+    x0, y0 = map_to_curve(u_pairs[0], sqrt_bit_getter, inv_bit_getter)
+    x1, y1 = map_to_curve(u_pairs[1], sqrt_bit_getter, inv_bit_getter)
+    b = u_pairs.shape[-1]
+    one_z = F.one((b,))
+    inf = jnp.zeros((b,), bl.DTYPE) != 0  # computed, not an i1 splat
+    q = blc.xc.pt_add(F, (x0, y0, one_z, inf), (x1, y1, one_z, inf))
+    return blc.clear_cofactor(F, q, x_bit_getter)
+
+
+# ---------------------------------------------------------------------------
+# Decompression + subgroup check (port of ops/h2c decompress path)
+# ---------------------------------------------------------------------------
+
+def decompress_g2_bl(x, sign_bit, F, sqrt_bit_getter):
+    """x: (2, 32, B) mont; sign_bit: (B,) bool. -> (point, on_curve)."""
+    gx = bl.f2_add(bl.f2_mul(bl.f2_sqr(x), x), _csec_f2("B_G2"))
+    y, on_curve = sqrt_f2(gx, sqrt_bit_getter)
+    is_largest = lex_largest_f2(y)
+    y = blc._sel(jnp.not_equal(is_largest, sign_bit), bl.f2_neg(y), y)
+    b = x.shape[-1]
+    inf = jnp.zeros((b,), bl.DTYPE) != 0
+    return (x, y, F.one((b,)), inf), on_curve

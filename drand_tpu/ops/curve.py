@@ -125,8 +125,13 @@ def g2_from_device(pt) -> hcurves.PointG2:
 # ---------------------------------------------------------------------------
 
 def pt_select(F, cond, a, b):
+    # the inf flag is selected through int32: Mosaic cannot lower selects
+    # whose BRANCHES are i1 vectors (i8 truncation path); the bool->int
+    # conversion itself goes through where (astype lowers as an invalid
+    # i1->i32 vreg bitcast)
+    inf = jnp.where(cond, jnp.where(a[3], 1, 0), jnp.where(b[3], 1, 0)) != 0
     return (F.select(cond, a[0], b[0]), F.select(cond, a[1], b[1]),
-            F.select(cond, a[2], b[2]), jnp.where(cond, a[3], b[3]))
+            F.select(cond, a[2], b[2]), inf)
 
 
 def pt_infinity(F, batch_shape):
@@ -171,7 +176,10 @@ def pt_add(F, p1, p2):
     X3 = F.sub(F.sqr(r), F.add(J, F.mul_small(V, 2)))
     Y3 = F.sub(F.mul(r, F.sub(V, X3)), F.mul_small(F.mul(S1, J), 2))
     Z3 = F.mul(F.sub(F.sqr(F.add(Z1, Z2)), F.add(Z1Z1, Z2Z2)), H)
-    added = (X3, Y3, Z3, jnp.zeros_like(inf1))
+    # inf flags DERIVED from operands (no constant bool vectors: Mosaic
+    # lowers an i1 splat through an i8 buffer whose i1 truncation is
+    # unsupported — "Unsupported target bitwidth for truncation")
+    added = (X3, Y3, Z3, inf1 & ~inf1)
 
     h_zero = F.is_zero(H)
     s_zero = F.is_zero(Sd)
@@ -180,8 +188,10 @@ def pt_add(F, p1, p2):
     inf_case = h_zero & (~s_zero) & both_live
 
     batch_shape = jnp.broadcast_shapes(inf1.shape, inf2.shape)
+    inf_pt = (F.one(batch_shape), F.one(batch_shape), F.zero(batch_shape),
+              jnp.broadcast_to(inf1 | ~inf1, batch_shape))
     out = pt_select(F, dbl_case, pt_dbl(F, p1), added)
-    out = pt_select(F, inf_case, pt_infinity(F, batch_shape), out)
+    out = pt_select(F, inf_case, inf_pt, out)
     out = pt_select(F, inf2 & ~inf1, p1, out)
     out = pt_select(F, inf1, p2, out)
     return out

@@ -57,6 +57,14 @@ DEFAULT_BUCKETS = (4, 128)
 PALLAS_MIN_BUCKET = int(os.environ.get("DRAND_TPU_PALLAS_MIN", "32"))
 
 
+def _pallas_ok(b: int) -> bool:
+    """Pallas kernels are compiled by Mosaic — TPU only (the CPU backend
+    runs the XLA graphs, which are correct there at every batch size)."""
+    import jax
+
+    return b >= PALLAS_MIN_BUCKET and jax.default_backend() == "tpu"
+
+
 def _bucket(n: int, buckets) -> int:
     for b in buckets:
         if n <= b:
@@ -100,11 +108,15 @@ class BatchedEngine:
             lambda pts, bits: curve.pt_to_affine(
                 curve.F2, curve.msm_pippenger(curve.F2, pts, bits)))
         self._msg_cache: dict[tuple[bytes, bytes], PointG2] = {}
-        # wire-prep: hash-to-curve + decompression + subgroup checks run on
-        # the DEVICE (ops/h2c.py) instead of ~60ms/item of host Python —
-        # the catch-up throughput fix. Opt-in while the graph is young.
+        # wire-prep: hash-to-curve + decompression + subgroup checks run
+        # on the DEVICE (Pallas kernels at bucket >= PALLAS_MIN_BUCKET,
+        # the XLA graph below) instead of ~60ms/item of host Python — the
+        # catch-up throughput fix. DRAND_TPU_WIRE_PREP: "auto" (default,
+        # wire path for batches that reach the Pallas bucket), "1"
+        # (always), "0" (never).
         if wire_prep is None:
-            wire_prep = os.environ.get("DRAND_TPU_WIRE_PREP", "0") == "1"
+            mode = os.environ.get("DRAND_TPU_WIRE_PREP", "auto")
+            wire_prep = {"auto": None, "1": True, "0": False}.get(mode)
         self.wire_prep = wire_prep
         self._verify_wire = jax.jit(self._wire_graph)
         # Known-answer validation per bucket: the axon TPU stack's libtpu
@@ -222,7 +234,7 @@ class BatchedEngine:
                 continue
             pubs[i], sigs[i], msgs[i] = _g1_aff(pub), _g2_aff(sig), _g2_aff(msg_pt)
             valid[i] = True
-        if b >= PALLAS_MIN_BUCKET:
+        if _pallas_ok(b):
             from . import pallas_pairing
 
             ok = np.asarray(pallas_pairing.verify_prepared_pl(
@@ -240,7 +252,10 @@ class BatchedEngine:
         (client/verify.go:146-163 made parallel). Returns per-beacon bools."""
         from ..chain import beacon as chain_beacon
 
-        if self.wire_prep:
+        n_checks = sum(1 + (1 if bcn.is_v2() else 0) for bcn in beacons)
+        use_wire = (self.wire_prep if self.wire_prep is not None
+                    else n_checks >= PALLAS_MIN_BUCKET)
+        if use_wire:
             checks = []  # (msg_bytes, sig_bytes)
             spans = []
             for bcn in beacons:
@@ -323,10 +338,15 @@ class BatchedEngine:
         pad_sig = _PAD_SIG()
         sigs = [s for _, s in checks] + [pad_sig] * (b - n)
         xs, sign, valid = h2c.sigs_to_x(sigs)
-        pubs = np.broadcast_to(_g1_aff(pubkey), (b, 2, limb.NLIMBS))
-        ok = np.asarray(self._verify_wire(
-            jnp.asarray(pubs), jnp.asarray(xs), jnp.asarray(sign),
-            jnp.asarray(u)))
+        if _pallas_ok(b):
+            from . import pallas_wire
+
+            ok = pallas_wire.verify_wire_pl(_g1_aff(pubkey), u, xs, sign)
+        else:
+            pubs = np.broadcast_to(_g1_aff(pubkey), (b, 2, limb.NLIMBS))
+            ok = np.asarray(self._verify_wire(
+                jnp.asarray(pubs), jnp.asarray(xs), jnp.asarray(sign),
+                jnp.asarray(u)))
         return (ok & valid)[:n]
 
     def verify_sigs(self, pubkey: PointG1, pairs,

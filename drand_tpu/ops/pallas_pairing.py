@@ -141,16 +141,23 @@ def _add_step(T, q, xp, yp):
     return (Xn, Yn, Zn), (c0, c3, c5)
 
 
-def _sparse_mul_035(f, lines, npairs: int):
+def _sparse_mul_035(f, lines, npairs: int, split: bool = False):
     """f * L_j for per-pair lines L = c0 + c3*w^3 + c5*w^5, folded in
-    sequentially. One stacked f2_mul per pair (slots from the M-twist
-    untwist — see ops/pairing._sparse_mul_035)."""
+    sequentially (slots from the M-twist untwist — see
+    ops/pairing._sparse_mul_035). ``split`` computes the three
+    coefficient products as separate f2_muls instead of one stacked one —
+    ~3x smaller peak temporaries, used inside VMEM-bounded kernels."""
     c0, c3, c5 = lines  # each (NP, 2, 32, B)
     for j in range(npairs):
         fw = f12_to_w(f)  # (6, 2, 32, B)
-        cj = jnp.stack([c0[j], c3[j], c5[j]], axis=0)  # (3, 2, 32, B)
-        prod = f2_mul(fw[None], cj[:, None])  # (3, 6, 2, 32, B)
-        p0, p3, p5 = prod[0], prod[1], prod[2]
+        if split:
+            p0 = f2_mul(fw, c0[j][None])
+            p3 = f2_mul(fw, c3[j][None])
+            p5 = f2_mul(fw, c5[j][None])
+        else:
+            cj = jnp.stack([c0[j], c3[j], c5[j]], axis=0)  # (3, 2, 32, B)
+            prod = f2_mul(fw[None], cj[:, None])  # (3, 6, 2, 32, B)
+            p0, p3, p5 = prod[0], prod[1], prod[2]
         out = []
         for k in range(6):
             term = p0[k]
@@ -258,26 +265,65 @@ def multi_pairing_bl(xp, yp, q):
 # Pallas kernels
 # ---------------------------------------------------------------------------
 
-def _pallas(kernel, out_shape, in_memspaces):
+def _pallas(kernel, out_shape, in_memspaces, scratch_shapes=()):
     """pallas_call with per-input memory spaces: 'v' = VMEM tensor input,
-    's' = SMEM scalar table (bit schedules, read element-wise)."""
+    's' = SMEM scalar table (bit schedules, read element-wise).
+    scratch_shapes: (shape, ...) tuples allocated as VMEM scratch refs."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     spaces = {"v": pltpu.VMEM, "s": pltpu.SMEM}
+    out_specs = jax.tree.map(
+        lambda _: pl.BlockSpec(memory_space=pltpu.VMEM), out_shape)
     return pl.pallas_call(
         kernel,
         out_shape=out_shape,
         in_specs=[pl.BlockSpec(memory_space=spaces[c])
                   for c in in_memspaces],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_specs=out_specs,
+        scratch_shapes=[pltpu.VMEM(s, DTYPE) for s in scratch_shapes],
     )
 
 
-def _miller_kernel(c_ref, flags_ref, xp_ref, yp_ref, q_ref, o_ref):
+def _miller_kernel(c_ref, flags_ref, xp_ref, yp_ref, q_ref, o_ref,
+                   f_ref, tx_ref, ty_ref, tz_ref):
+    """Miller loop with scratch-ref state and @pl.when-gated add steps:
+    |x| has hamming weight 6, so the mixed addition + its sparse multiply
+    are SKIPPED at runtime on 57 of 63 iterations (the masked-select
+    variant in miller_loop_bl computes them every iteration — ~1.4x more
+    work; that pure-jnp version remains the CPU-testable reference)."""
+    from jax.experimental import pallas as pl
+
     with bl.const_context(c_ref[:]):
-        o_ref[:] = miller_loop_bl(xp_ref[:], yp_ref[:], q_ref[:],
-                                  smem_bit_getter(flags_ref))
+        xp, yp, q = xp_ref[:], yp_ref[:], q_ref[:]
+        npairs = q.shape[0]
+        b = q.shape[-1]
+        xq, yq = q[..., 0, :, :, :], q[..., 1, :, :, :]
+        one_fp = jnp.broadcast_to(
+            bl._crow("ONE"), xq.shape[:-3] + (NLIMBS, b)).astype(DTYPE)
+        f_ref[:] = f12_one((), b)
+        tx_ref[:] = xq
+        ty_ref[:] = yq
+        tz_ref[:] = jnp.stack([one_fp, jnp.zeros_like(one_fp)], axis=-3)
+
+        def body(i, carry):
+            f = f12_sqr(f_ref[:])
+            T, lines = _dbl_step((tx_ref[:], ty_ref[:], tz_ref[:]), xp, yp)
+            f_ref[:] = _sparse_mul_035(f, lines, npairs, split=True)
+            tx_ref[:], ty_ref[:], tz_ref[:] = T
+
+            @pl.when(flags_ref[0, i] != 0)
+            def _add():
+                Ta, lines_a = _add_step(
+                    (tx_ref[:], ty_ref[:], tz_ref[:]), q, xp, yp)
+                f_ref[:] = _sparse_mul_035(f_ref[:], lines_a, npairs,
+                                           split=True)
+                tx_ref[:], ty_ref[:], tz_ref[:] = Ta
+
+            return carry
+
+        jax.lax.fori_loop(0, N_MILLER, body, 0)
+        o_ref[:] = f12_conj(f_ref[:])  # x < 0
 
 
 def _easy_kernel(c_ref, pm2_ref, f_ref, o_ref):
@@ -286,10 +332,27 @@ def _easy_kernel(c_ref, pm2_ref, f_ref, o_ref):
             f_ref[:], bit_getter=smem_bit_getter(pm2_ref))
 
 
-def _pow_kernel(nbits: int, c_ref, bits_ref, m_ref, o_ref):
+def _pow_kernel(nbits: int, c_ref, bits_ref, m_ref, o_ref, acc_ref):
+    """Cyclotomic pow with the f12 multiply under @pl.when — skipped at
+    runtime on zero bits (the |x| chains have hamming weight 6/64; the
+    |x-1| chains are dense, where it is roughly cost-neutral)."""
+    from jax.experimental import pallas as pl
+
     with bl.const_context(c_ref[:]):
-        o_ref[:] = cyc_pow_neg_bl(m_ref[:], smem_bit_getter(bits_ref),
-                                  nbits)
+        base = f12_conj(m_ref[:])
+        acc_ref[:] = f12_one((), m_ref.shape[-1])
+
+        def body(i, carry):
+            acc_ref[:] = f12_cyclotomic_sqr(acc_ref[:])
+
+            @pl.when(bits_ref[0, i] != 0)
+            def _mul():
+                acc_ref[:] = f12_mul(acc_ref[:], base)
+
+            return carry
+
+        jax.lax.fori_loop(0, nbits, body, 0)
+        o_ref[:] = acc_ref[:]
 
 
 # The XLA glue between kernels is NOT safe on the axon stack (the same
@@ -333,14 +396,18 @@ def _verify_pl(xp, yp, q, npairs: int, b: int):
     consts = jnp.asarray(bl.CONST_BUFFER)
     f12_shape = jax.ShapeDtypeStruct((2, 3, 2, NLIMBS, b), DTYPE)
 
-    f = _pallas(_miller_kernel, f12_shape, "vsvvv")(
+    f12_dims = (2, 3, 2, NLIMBS, b)
+    t_dims = (npairs, 2, NLIMBS, b)
+    f = _pallas(_miller_kernel, f12_shape, "vsvvv",
+                scratch_shapes=(f12_dims, t_dims, t_dims, t_dims))(
         consts, jnp.asarray(MILLER_FLAGS), xp, yp, q)
     m = _pallas(_easy_kernel, f12_shape, "vsv")(
         consts, jnp.asarray(PM2_FLAT), f)
 
     def pow_neg(x, bits2d, nbits):
         return _pallas(functools.partial(_pow_kernel, nbits),
-                       f12_shape, "vsv")(consts, jnp.asarray(bits2d), x)
+                       f12_shape, "vsv", scratch_shapes=(f12_dims,))(
+            consts, jnp.asarray(bits2d), x)
 
     a1 = pow_neg(m, BITS_XM1, N_XM1)
     a2 = pow_neg(a1, BITS_XM1, N_XM1)

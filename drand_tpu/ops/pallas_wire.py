@@ -1,0 +1,215 @@
+"""Wire-format BLS verification fully on device: Pallas kernels for
+hash-to-G2, decompression, subgroup checks, then the pairing chain.
+
+End-to-end catch-up (client/verify.go:146-163) and aggregator
+re-verification take WIRE inputs: message bytes + 96-byte compressed
+signatures. The host formerly paid ~45ms (hash-to-curve) + ~18ms
+(subgroup-checked decompression) of pure Python per item; here the host
+does only SHA-256 expansion + byte splitting (ops/h2c.msgs_to_u /
+sigs_to_x) and everything else runs as batch-last Mosaic kernels
+(ops/bl_h2c.py, ops/bl_curve.py — ψ fast paths), feeding the pairing
+kernels of ops/pallas_pairing.py. Per the axon-stack rule (see
+pallas_pairing), NO per-element XLA runs between kernels.
+
+Kernel chain (per batch of B lanes):
+    K_map (x2)  u-value -> pre-clearing E2 point        [sswu + isogeny]
+    K_ptadd     q0 + q1                                 [Jacobian add]
+    K_mulx (x2) [x]P chains of Budroni-Pintore          [64-bit fori]
+    K_glue      BP combination + to-affine              [ψ, adds]
+    K_sig       decompress + Scott subgroup + to-affine
+    ... then miller/easy/pow/is_one from pallas_pairing.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import bl, bl_curve as blc, bl_h2c as blh
+from . import curve as xc
+from . import pallas_pairing as pp
+from .bl import DTYPE, NLIMBS
+
+# bit tables (SMEM inputs)
+SQRT_BITS = blh.SQRT_BITS          # (1, 768)
+X_BITS = blc.X_BITS                # (1, 64)
+PM2_FLAT = pp.PM2_FLAT             # (1, 384)
+
+
+def _mask_out(ok, shape0=8):
+    """(B,) bool -> (8, B) int32 tile-safe output (bool->int via where:
+    astype lowers as an invalid i1->i32 vreg bitcast in Mosaic)."""
+    return jnp.broadcast_to(jnp.where(ok, 1, 0)[None, :],
+                            (shape0, ok.shape[-1])).astype(DTYPE)
+
+
+def _kernel_f2(pm2_ref):
+    """Batch-last F2 namespace whose inversions read the p-2 bits from an
+    SMEM ref (kernels cannot dynamic-slice values)."""
+    return blc.make_f2(pp.smem_bit_getter(pm2_ref))
+
+
+# ---------------------------------------------------------------------------
+# Kernels
+# ---------------------------------------------------------------------------
+
+def _map_kernel(c_ref, sqrt_ref, pm2_ref, u_ref, ox_ref, oy_ref):
+    """One u-value -> affine pre-clearing E2 point (sswu + isogeny)."""
+    with bl.const_context(c_ref[:]):
+        x, y = blh.map_to_curve(u_ref[:], pp.smem_bit_getter(sqrt_ref),
+                                pp.smem_bit_getter(pm2_ref))
+        ox_ref[:] = x
+        oy_ref[:] = y
+
+
+def _ptadd_affine_kernel(c_ref, x0_ref, y0_ref, x1_ref, y1_ref,
+                         ox_ref, oy_ref, oz_ref, oinf_ref):
+    """Jacobian sum of two affine points (never infinity inputs — map
+    outputs); Jacobian out."""
+    with bl.const_context(c_ref[:]):
+        b = x0_ref.shape[-1]
+        F = blc.make_f2()  # no inversion used in pt_add
+        one_z = F.one((b,))
+        inf0 = jnp.zeros((b,), DTYPE) != 0  # computed, not an i1 splat
+        out = xc.pt_add(F, (x0_ref[:], y0_ref[:], one_z, inf0),
+                        (x1_ref[:], y1_ref[:], one_z, inf0))
+        ox_ref[:], oy_ref[:], oz_ref[:] = out[0], out[1], out[2]
+        oinf_ref[:] = _mask_out(out[3])
+
+
+def _mulx_kernel(c_ref, xbits_ref, x_ref, y_ref, z_ref, inf_ref,
+                 ox_ref, oy_ref, oz_ref, oinf_ref):
+    """[x]P (x < 0) on a Jacobian point."""
+    with bl.const_context(c_ref[:]):
+        F = blc.make_f2()
+        p = (x_ref[:], y_ref[:], z_ref[:], inf_ref[0] != 0)
+        out = blc.mul_x(F, p, pp.smem_bit_getter(xbits_ref))
+        ox_ref[:], oy_ref[:], oz_ref[:] = out[0], out[1], out[2]
+        oinf_ref[:] = _mask_out(out[3])
+
+
+def _clear_glue_kernel(c_ref, pm2_ref,
+                       px_ref, py_ref, pz_ref, pinf_ref,
+                       t1x_ref, t1y_ref, t1z_ref, t1inf_ref,
+                       t2x_ref, t2y_ref, t2z_ref, t2inf_ref,
+                       ox_ref, oy_ref, oinf_ref):
+    """Budroni-Pintore combination [x²−x−1]P + ψ([x−1]P) + ψ²([2]P) from
+    precomputed t1 = [x]P, t2 = [x²]P; then to-affine."""
+    with bl.const_context(c_ref[:]):
+        F = _kernel_f2(pm2_ref)
+        p = (px_ref[:], py_ref[:], pz_ref[:], pinf_ref[0] != 0)
+        t1 = (t1x_ref[:], t1y_ref[:], t1z_ref[:], t1inf_ref[0] != 0)
+        t2 = (t2x_ref[:], t2y_ref[:], t2z_ref[:], t2inf_ref[0] != 0)
+        part1 = xc.pt_add(F, xc.pt_add(F, t2, xc.pt_neg(F, t1)),
+                          xc.pt_neg(F, p))
+        part2 = blc.psi(xc.pt_add(F, t1, xc.pt_neg(F, p)))
+        part3 = blc.psi2(xc.pt_dbl(F, p))
+        out = xc.pt_add(F, xc.pt_add(F, part1, part2), part3)
+        ax, ay, ainf = xc.pt_to_affine(F, out)
+        ox_ref[:], oy_ref[:] = ax, ay
+        oinf_ref[:] = _mask_out(ainf)
+
+
+def _sig_kernel(c_ref, sqrt_ref, xbits_ref, pm2_ref, sx_ref, sign_ref,
+                ox_ref, oy_ref, ook_ref):
+    """Compressed-signature pipeline: decompress (sqrt + zcash sign rule),
+    Scott subgroup check, to-affine. ok = on_curve & in_subgroup."""
+    with bl.const_context(c_ref[:]):
+        F = _kernel_f2(pm2_ref)
+        sign_bit = sign_ref[0] != 0
+        pt, on_curve = blh.decompress_g2_bl(
+            sx_ref[:], sign_bit, F, pp.smem_bit_getter(sqrt_ref))
+        in_sub = blc.subgroup_check(F, pt, pp.smem_bit_getter(xbits_ref))
+        ox_ref[:] = pt[0]
+        oy_ref[:] = pt[1]
+        ook_ref[:] = _mask_out(on_curve & in_sub)
+
+
+# ---------------------------------------------------------------------------
+# The jitted chain
+# ---------------------------------------------------------------------------
+
+def _f2shape(b):
+    return jax.ShapeDtypeStruct((2, NLIMBS, b), DTYPE)
+
+
+def _mask_shape(b):
+    return jax.ShapeDtypeStruct((8, b), DTYPE)
+
+
+def _pt_shapes(b):
+    return (_f2shape(b), _f2shape(b), _f2shape(b), _mask_shape(b))
+
+
+@functools.partial(jax.jit, static_argnames=("b",))
+def _hash_msgs_pl(u_pairs, b: int):
+    """u_pairs (2, 2, 32, B) -> affine message point (x, y) on G2."""
+    # lane-broadcast const buffer: these kernels multiply constants into
+    # the convolution (see bl.mont_mul docstring)
+    consts = jnp.asarray(bl.lane_buffer(b))
+    sqrt_b = jnp.asarray(SQRT_BITS)
+    pm2_b = jnp.asarray(PM2_FLAT)
+    xb = jnp.asarray(X_BITS)
+
+    map_call = pp._pallas(_map_kernel, (_f2shape(b), _f2shape(b)), "vssv")
+    x0, y0 = map_call(consts, sqrt_b, pm2_b, u_pairs[0])
+    x1, y1 = map_call(consts, sqrt_b, pm2_b, u_pairs[1])
+    q = pp._pallas(_ptadd_affine_kernel, _pt_shapes(b), "vvvvv")(
+        consts, x0, y0, x1, y1)
+    mulx = pp._pallas(_mulx_kernel, _pt_shapes(b), "vsvvvv")
+    t1 = mulx(consts, xb, *q)
+    t2 = mulx(consts, xb, *t1)
+    mx, my, minf = pp._pallas(
+        _clear_glue_kernel, (_f2shape(b), _f2shape(b), _mask_shape(b)),
+        "vs" + "v" * 12)(consts, pm2_b, *q, *t1, *t2)
+    return mx, my, minf
+
+
+@functools.partial(jax.jit, static_argnames=("b",))
+def _sig_pl(sig_x, sign_mask, b: int):
+    consts = jnp.asarray(bl.lane_buffer(b))
+    return pp._pallas(_sig_kernel,
+                      (_f2shape(b), _f2shape(b), _mask_shape(b)),
+                      "vsssvv")(
+        consts, jnp.asarray(SQRT_BITS), jnp.asarray(X_BITS),
+        jnp.asarray(PM2_FLAT), sig_x, sign_mask)
+
+
+@functools.partial(jax.jit, static_argnames=("b",))
+def _wire_verify_pl(pub_xp, pub_yp, u_pairs, sig_x, sign_mask, b: int):
+    """Full wire check per lane: decompress+subgroup the signature, hash
+    the message, then the pairing chain. pub_xp/yp: (32, B) G1 affine
+    coords of the (broadcast) public key."""
+    sx, sy, sig_ok = _sig_pl(sig_x, sign_mask, b)
+    mx, my, minf = _hash_msgs_pl(u_pairs, b)
+
+    neg = np.asarray(pp._neg_g1_np())  # (2, 32)
+    ng1x = jnp.broadcast_to(jnp.asarray(neg[0])[:, None], (NLIMBS, b))
+    ng1y = jnp.broadcast_to(jnp.asarray(neg[1])[:, None], (NLIMBS, b))
+    xp = jnp.stack([ng1x, pub_xp])            # (NP, 32, B)
+    yp = jnp.stack([ng1y, pub_yp])
+    sig_aff = jnp.stack([sx, sy])             # (2coord, 2, 32, B)
+    msg_aff = jnp.stack([mx, my])
+    q = jnp.stack([sig_aff, msg_aff])         # (NP, 2, 2, 32, B)
+    pair_ok = pp._verify_pl(xp, yp, q, npairs=2, b=b)
+    return pair_ok & (sig_ok[0] != 0) & (minf[0] == 0)
+
+
+def verify_wire_pl(pubkey_aff, u_pairs_np, sig_x_np, sign_np) -> np.ndarray:
+    """Host entry: pubkey_aff (2, 32) mont limbs; u_pairs_np (B, 2, 2, 32)
+    batch-leading (ops/h2c.msgs_to_u layout); sig_x_np (B, 2, 32); sign_np
+    (B,) bool. Returns (B,) bool."""
+    b = u_pairs_np.shape[0]
+    u_bl = jnp.asarray(np.moveaxis(u_pairs_np, 0, -1))  # (2, 2, 32, B)
+    sig_bl = jnp.asarray(np.moveaxis(sig_x_np, 0, -1))  # (2, 32, B)
+    sign_mask = jnp.asarray(
+        np.broadcast_to(sign_np.astype(np.int32)[None, :], (8, b)))
+    pub_xp = jnp.asarray(np.broadcast_to(pubkey_aff[0][:, None],
+                                         (NLIMBS, b)))
+    pub_yp = jnp.asarray(np.broadcast_to(pubkey_aff[1][:, None],
+                                         (NLIMBS, b)))
+    return np.asarray(_wire_verify_pl(pub_xp, pub_yp, u_bl, sig_bl,
+                                      sign_mask, b))

@@ -1,0 +1,85 @@
+"""Golden tests: batch-last G2 point arithmetic + ψ fast paths
+(ops/bl_curve.py) vs the host curve and endo oracles."""
+
+import random
+
+import numpy as np
+import jax.numpy as jnp
+
+from drand_tpu.crypto import endo
+from drand_tpu.crypto import hash_to_curve as h2c
+from drand_tpu.crypto.curves import PointG2
+from drand_tpu.crypto.fields import R
+from drand_tpu.ops import bl_curve as blc
+from drand_tpu.ops import curve as xc
+from drand_tpu.ops.pallas_pairing import value_bit_getter
+
+rng = random.Random(0xB1C2)
+B = 4
+
+
+def rand_points(n=B, subgroup=True):
+    if subgroup:
+        return [PointG2.generator().mul(rng.randrange(1, R))
+                for _ in range(n)]
+    out = []
+    for i in range(n):
+        u0, u1 = h2c.hash_to_field_fp2(b"blc-%d-%d" % (i, rng.random() < 2),
+                                       h2c.DEFAULT_DST_G2, 2)
+        out.append(h2c.map_to_curve_g2(u0) + h2c.map_to_curve_g2(u1))
+    return out
+
+
+def x_getter():
+    return value_bit_getter(jnp.asarray(blc.X_BITS))
+
+
+def test_pt_add_dbl_golden():
+    ps = rand_points()
+    qs = rand_points()
+    dp = blc.pack_g2_points(ps)
+    dq = blc.pack_g2_points(qs)
+    got_add = blc.unpack_g2_points(xc.pt_add(blc.F2, dp, dq))
+    assert got_add == [p + q for p, q in zip(ps, qs)]
+    got_dbl = blc.unpack_g2_points(xc.pt_dbl(blc.F2, dp))
+    assert got_dbl == [p.double() for p in ps]
+    # exceptional cases: P + P, P + (-P), P + inf
+    dnegp = blc.pack_g2_points([-p for p in ps])
+    assert blc.unpack_g2_points(xc.pt_add(blc.F2, dp, dp)) == \
+        [p.double() for p in ps]
+    assert all(r.is_infinity()
+               for r in blc.unpack_g2_points(xc.pt_add(blc.F2, dp, dnegp)))
+    dinf = blc.pack_g2_points([PointG2.infinity()] * B)
+    assert blc.unpack_g2_points(xc.pt_add(blc.F2, dp, dinf)) == ps
+
+
+def test_psi_golden():
+    ps = rand_points(subgroup=False)
+    dp = blc.pack_g2_points(ps)
+    assert blc.unpack_g2_points(blc.psi(dp)) == [endo.psi(p) for p in ps]
+    assert blc.unpack_g2_points(blc.psi2(dp)) == [endo.psi2(p) for p in ps]
+
+
+def test_mul_x_and_subgroup_check():
+    from drand_tpu.crypto.fields import X_BLS
+
+    ps = rand_points()
+    dp = blc.pack_g2_points(ps)
+    got = blc.unpack_g2_points(blc.mul_x(blc.F2, dp, x_getter()))
+    assert got == [endo._mul_int(p, X_BLS) for p in ps]
+    ok = np.asarray(blc.subgroup_check(blc.F2, dp, x_getter()))
+    assert ok.all()
+    bad = rand_points(subgroup=False)
+    dbad = blc.pack_g2_points(bad)
+    ok_bad = np.asarray(blc.subgroup_check(blc.F2, dbad, x_getter()))
+    assert not ok_bad.any()
+
+
+def test_clear_cofactor_golden():
+    ps = rand_points(subgroup=False)
+    dp = blc.pack_g2_points(ps)
+    got = blc.unpack_g2_points(
+        blc.clear_cofactor(blc.F2, dp, x_getter()))
+    want = [endo.clear_cofactor_fast(p) for p in ps]
+    assert got == want
+    assert all(g.in_subgroup() for g in got)

@@ -1,0 +1,48 @@
+"""ψ endomorphism fast paths (crypto/endo.py): Scott subgroup check and
+Budroni-Pintore cofactor clearing vs the generic scalar oracles."""
+
+import random
+
+from drand_tpu.crypto import endo
+from drand_tpu.crypto import hash_to_curve as h2c
+from drand_tpu.crypto.curves import PointG2
+from drand_tpu.crypto.fields import R
+from drand_tpu.crypto.hash_to_curve import _H_CLEAR
+
+rng = random.Random(0xE2D0)
+
+
+def _pre_clearing_point(tag: bytes) -> PointG2:
+    """A curve point NOT (generically) in the r-order subgroup."""
+    u0, u1 = h2c.hash_to_field_fp2(tag, h2c.DEFAULT_DST_G2, 2)
+    return h2c.map_to_curve_g2(u0) + h2c.map_to_curve_g2(u1)
+
+
+def test_psi_eigenvalue_on_subgroup():
+    from drand_tpu.crypto.fields import X_BLS
+
+    for _ in range(3):
+        g = PointG2.generator().mul(rng.randrange(1, R))
+        assert endo.psi(g) == endo._mul_int(g, X_BLS)
+        assert endo.psi2(g) == endo.psi(endo.psi(g))
+
+
+def test_subgroup_check_accepts_and_rejects():
+    for _ in range(3):
+        g = PointG2.generator().mul(rng.randrange(1, R))
+        assert endo.subgroup_check_fast(g)
+        assert g.in_subgroup()  # oracle agrees
+    for i in range(3):
+        q = _pre_clearing_point(b"reject-%d" % i)
+        assert endo.subgroup_check_fast(q) == q.in_subgroup()
+        # a random map output is (overwhelmingly) outside the subgroup
+        assert not endo.subgroup_check_fast(q)
+
+
+def test_bp_clearing_equals_generic():
+    for i in range(3):
+        q = _pre_clearing_point(b"clear-%d" % i)
+        assert endo.clear_cofactor_fast(q) == q.mul(_H_CLEAR)
+    # and the cleared point is in the subgroup
+    assert endo.clear_cofactor_fast(
+        _pre_clearing_point(b"clear-final")).in_subgroup()

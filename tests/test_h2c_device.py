@@ -41,8 +41,15 @@ def test_hash_to_g2_device_matches_host():
 
 def test_decompress_device_matches_host_and_rejects_off_curve():
     sigs = [bls.sign(0x1234, b"sig-a"), bls.sign(0x5678, b"sig-b")]
+    # tweak x until it is REALLY off the curve (a random x is on the curve
+    # with probability ~1/2 — the host decoder is the arbiter)
     bad = bytearray(sigs[1])
-    bad[7] ^= 0xFF  # x not on the curve (w.h.p.)
+    while True:
+        bad[7] = (bad[7] + 1) % 256
+        try:
+            PointG2.from_bytes(bytes(bad), subgroup_check=False)
+        except ValueError:
+            break
     xs, sign, valid = h2c.sigs_to_x([sigs[0], bytes(bad)])
     assert valid.tolist() == [True, True]  # header/range fine; curve check
     pt, on_curve = jax.jit(h2c.decompress_g2_device)(jnp.asarray(xs),
