@@ -34,12 +34,7 @@ from ..crypto.fields import _FP2_ROOTS_OF_UNITY_4
 from . import bl, bl_curve as blc
 from . import limb as _limb
 from .bl import DTYPE, MASK, NLIMBS
-from .bl_curve import _csec_f2
-
-
-def _f2_rows(x) -> np.ndarray:
-    return np.stack([_limb.int_to_mont_limbs(x.c0),
-                     _limb.int_to_mont_limbs(x.c1)])
+from .bl_curve import _csec_f2, _f2_rows
 
 
 _X0, _V_SUM, _U_SUM, _C2, _C3 = _ISO_PARAMS

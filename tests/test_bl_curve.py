@@ -24,7 +24,7 @@ def rand_points(n=B, subgroup=True):
                 for _ in range(n)]
     out = []
     for i in range(n):
-        u0, u1 = h2c.hash_to_field_fp2(b"blc-%d-%d" % (i, rng.random() < 2),
+        u0, u1 = h2c.hash_to_field_fp2(b"blc-%d-%d" % (i, rng.randrange(99)),
                                        h2c.DEFAULT_DST_G2, 2)
         out.append(h2c.map_to_curve_g2(u0) + h2c.map_to_curve_g2(u1))
     return out
