@@ -150,3 +150,17 @@ def recover(pub_poly: PubPoly, msg: bytes, partials, t: int, n: int,
                 raise
             _note_fallback("recover", e)
     return tbls.recover(pub_poly, msg, partials, t, n, dst)
+
+
+def eval_commits(polys: list[PubPoly], index: int) -> list[PointG1]:
+    """Evaluate many commitment polynomials at one index — the DKG deal
+    share-check `g·s_d == Σ_k C_{d,k}·index^k` done for every dealer at
+    once (BASELINE config "n=128 deal verify"; kyber vss VerifyDeal)."""
+    if _use_device(len(polys)):
+        try:
+            return engine().eval_commits(polys, index)
+        except Exception as e:  # noqa: BLE001
+            if _MODE == "device":
+                raise
+            _note_fallback("eval_commits", e)
+    return [p.eval(index).value for p in polys]

@@ -114,6 +114,32 @@ class _JacobianPoint:
         zinv2 = zinv.square()
         return self.X * zinv2, self.Y * (zinv2 * zinv)
 
+    @classmethod
+    def batch_to_affine(cls, pts):
+        """Affine coords for many points with ONE field inversion
+        (Montgomery's simultaneous-inversion trick) — per-point
+        ``to_affine`` pays a full exponentiation-based inverse each,
+        which dominates host packing of large device batches.
+        Raises on any point at infinity, like :meth:`to_affine`."""
+        zs = []
+        for p in pts:
+            if p.is_infinity():
+                raise ValueError("point at infinity has no affine coords")
+            zs.append(p.Z)
+        if not zs:
+            return []
+        prefix = [zs[0]]
+        for z in zs[1:]:
+            prefix.append(prefix[-1] * z)
+        inv = prefix[-1].inverse()
+        out = [None] * len(pts)
+        for i in range(len(pts) - 1, -1, -1):
+            zinv = inv * prefix[i - 1] if i else inv
+            inv = inv * zs[i]
+            zinv2 = zinv.square()
+            out[i] = (pts[i].X * zinv2, pts[i].Y * (zinv2 * zinv))
+        return out
+
     def double(self):
         if self.is_infinity():
             return self

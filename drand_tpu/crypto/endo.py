@@ -100,12 +100,15 @@ def clear_cofactor_fast(p: PointG2) -> PointG2:
 
 
 def _validate() -> None:
-    # ψ eigenvalue on the subgroup
+    # Explicit raises (not assert): these import-time checks are the
+    # safety net for the probed ψ constants and must survive python -O.
     g = PointG2.generator().mul(0x77AB12)
-    assert psi(g) == _mul_int(g, X_BLS), "psi eigenvalue check failed"
-    assert psi2(g) == psi(psi(g)), "psi2 != psi∘psi"
-    # fast subgroup check accepts subgroup points
-    assert subgroup_check_fast(g)
+    if psi(g) != _mul_int(g, X_BLS):
+        raise ValueError("psi eigenvalue check failed")
+    if psi2(g) != psi(psi(g)):
+        raise ValueError("psi2 != psi∘psi")
+    if not subgroup_check_fast(g):
+        raise ValueError("fast subgroup check rejected a subgroup point")
     # BP cofactor clearing must equal the generic [h_eff] multiplication
     # on a NON-subgroup curve point (a hash_to_curve pre-clearing output)
     from .hash_to_curve import hash_to_g2  # noqa: F401 (import check)
@@ -113,8 +116,8 @@ def _validate() -> None:
 
     u0, u1 = h2c.hash_to_field_fp2(b"endo-validate", h2c.DEFAULT_DST_G2, 2)
     q = h2c.map_to_curve_g2(u0) + h2c.map_to_curve_g2(u1)
-    assert clear_cofactor_fast(q) == q.mul(_H_CLEAR), \
-        "Budroni-Pintore clearing != [h_eff] multiplication"
+    if clear_cofactor_fast(q) != q.mul(_H_CLEAR):
+        raise ValueError("Budroni-Pintore clearing != [h_eff] mult")
 
 
 _validate()

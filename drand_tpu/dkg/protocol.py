@@ -31,7 +31,7 @@ import asyncio
 import secrets
 from dataclasses import dataclass, field
 
-from ..crypto import ecies, schnorr
+from ..crypto import batch, ecies, schnorr
 from ..crypto.curves import PointG1
 from ..crypto.fields import R
 from ..crypto.poly import PriPoly, PriShare, PubPoly, lagrange_coefficients
@@ -133,8 +133,7 @@ class DKGProtocol:
         deals = await self._collect(
             self.board.deals, expect=len(dealers),
             issuer=lambda b: b.dealer_index)
-        for b in deals:
-            self._process_deal(b)
+        self._process_deals(deals)
 
         if self._share_index is not None:
             await self.board.push_responses(self._make_response_bundle(dealers))
@@ -183,25 +182,48 @@ class DKGProtocol:
             deals=tuple(deals), session_id=self.c.nonce)
         return _signed(bundle, self.c.longterm)
 
-    def _process_deal(self, b: DealBundle) -> None:
-        if b.dealer_index in self._valid_commits:
-            return  # first valid bundle per dealer wins
-        if len(b.commits) != self.c.threshold:
+    def _process_deals(self, bundles) -> None:
+        """Process a phase's deal bundles: admit commitments one by one,
+        then check our encrypted shares against ONE batched commitment
+        evaluation at our index (crypto.batch.eval_commits — the
+        reference's per-dealer vss.VerifyDeal loop as a single device
+        call; the secret share side g·s stays on the host)."""
+        pend = []
+        for b in bundles:
+            pub = self._admit_deal_commits(b)
+            if pub is not None and self._share_index is not None:
+                pend.append((b, pub))
+        if not pend:
             return
+        evals = batch.eval_commits([pub for _, pub in pend],
+                                   self._share_index)
+        for (b, pub), ev in zip(pend, evals):
+            self._check_own_share(b, ev)
+
+    def _admit_deal_commits(self, b: DealBundle) -> PubPoly | None:
+        """Commitment-shape and reshare-binding validation; records the
+        dealer's PubPoly. Returns it if newly admitted."""
+        if b.dealer_index in self._valid_commits:
+            return None  # first valid bundle per dealer wins
+        if len(b.commits) != self.c.threshold:
+            return None
         try:
             pub = PubPoly(b.commit_points())
         except ValueError:
-            return
+            return None
         if self._old_pub is not None:
             # dealer's constant term must be its OLD public share —
             # the key-preservation binding of a reshare
             if pub.commit() != self._old_pub.eval(b.dealer_index).value:
                 self._l.warn("dkg", "reshare_commit_mismatch",
                              dealer=b.dealer_index)
-                return
+                return None
         self._valid_commits[b.dealer_index] = pub
-        if self._share_index is None:
-            return
+        return pub
+
+    def _check_own_share(self, b: DealBundle, eval_point: PointG1) -> None:
+        """Decrypt our deal from this bundle and accept the share iff
+        g·s equals the dealer's commitment polynomial at our index."""
         for d in b.deals:
             if d.share_index != self._share_index:
                 continue
@@ -210,8 +232,7 @@ class DKGProtocol:
                 val = int.from_bytes(plain, "big") % R
             except Exception:  # noqa: BLE001 — malformed ciphertext
                 break
-            if PointG1.generator().mul(val) == \
-                    pub.eval(self._share_index).value:
+            if PointG1.generator().mul(val) == eval_point:
                 self._valid_shares[b.dealer_index] = val
             break
 

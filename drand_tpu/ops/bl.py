@@ -46,7 +46,10 @@ DTYPE = _x.DTYPE
 
 # conv strategy: "unroll" = 32 static shifted partial products (parallel,
 # bigger trace), "loop" = fori_loop accumulation (compact trace, serial).
-# Kernels read this at trace time; tests cover both.
+# TRACE-TIME constant: it is read when a kernel first compiles and is NOT
+# part of any jit cache key — set it before the first compile (e.g. in a
+# test's setup) and never flip it mid-process; a flip after compilation is
+# silently ignored for already-jitted callers. Tests cover both modes.
 CONV_MODE = "unroll"
 
 
@@ -241,9 +244,12 @@ def _wrap(t, passes: int, fold_rounds: int = 3):
 
 def reduce_light(t):
     """Normalize small overflows (limbs < 2^16). See limb.reduce_light for
-    the two-pass soundness argument."""
+    the THREE-pass soundness argument: two wrap passes can leave the value
+    ≥ 2^384 and truncate a live carry limb (the −R-off-by-one pairing bug
+    witnessed in tests/test_limb_regression.py); pass 3 provably lands
+    below 2^384."""
     t = _fold(t, rounds=1, grow=True)
-    return _wrap(t, passes=2, fold_rounds=2)
+    return _wrap(t, passes=3, fold_rounds=2)
 
 
 # ---------------------------------------------------------------------------

@@ -362,6 +362,48 @@ def msm_pippenger(F, points, bits, c: int = 4):
     return acc
 
 
+def msm_scan(F, points, bits):
+    """Interleaved-ladder MSM with BOTH loops under ``lax.scan`` — compile
+    size is O(1) in n and nbits (one pt_dbl + one pt_add + select in the
+    trace), where :func:`msm` unrolls the points axis and
+    :func:`msm_pippenger` traces a whole window body; on the XLA limb
+    path those unrolled graphs take >10 min to compile at n=128 on a
+    small host. Runtime is latency-bound (nbits·n sequential adds on a
+    single lane) — right for the aggregator's ONE recovery per round,
+    wrong for bulk throughput.
+
+    points: device point with batch shape (..., n); bits: (..., n, nbits)
+    MSB-first. Returns sum_i bits_i ⋅ points_i with batch shape (...,).
+    """
+    lead = F.elem_ndim + 1
+
+    def pts_axis_first(p):
+        return tuple(jnp.moveaxis(c, -lead, 0) for c in p[:3]) + (
+            jnp.moveaxis(p[3], -1, 0),)
+
+    pts = pts_axis_first(points)            # components (n, ..., elem)
+    p0 = tuple(c[0] for c in pts[:3]) + (pts[3][0],)
+    batch_shape = points[3].shape[:-1]
+    # (nbits, n, ...) — outer scan over bit positions, inner over points
+    bits_nf = jnp.moveaxis(jnp.moveaxis(bits, -1, 0), -1, 1) \
+        if bits.ndim > 2 else jnp.moveaxis(bits, -1, 0)[:, :]
+
+    def bit_step(acc, bit_col):
+        acc = pt_dbl(F, acc)
+
+        def pt_step(a, xs):
+            (px, py, pz, pinf, b_i) = xs
+            with_add = pt_add(F, a, (px, py, pz, pinf))
+            return pt_select(F, b_i.astype(bool), with_add, a), None
+
+        acc, _ = jax.lax.scan(pt_step, acc, pts + (bit_col,))
+        return acc, None
+
+    acc = _pt_infinity_like(F, p0, batch_shape)
+    acc, _ = jax.lax.scan(bit_step, acc, bits_nf)
+    return acc
+
+
 def msm(F, points, bits):
     """Multi-scalar multiplication over the trailing *points* axis.
 

@@ -53,8 +53,22 @@ from . import curve, limb, pairing, tower
 # compiler path and removes the per-op dispatch overhead. Every bucket is
 # still known-answer-validated before first use; failing buckets are
 # disabled automatically.
-DEFAULT_BUCKETS = (4, 128)
+DEFAULT_BUCKETS = (4, 128, 512)
 PALLAS_MIN_BUCKET = int(os.environ.get("DRAND_TPU_PALLAS_MIN", "32"))
+# wire-prep kernels hold more live state per lane (decompress + h2c +
+# pairing); cap their bucket size — larger batches chunk and pipeline
+WIRE_MAX_BUCKET = 128
+
+
+def _drain(launches) -> None:
+    """Block once on the LAST launch before pulling results: the device
+    executes launches in order, so when the last completes they all have
+    — while draining in-flight outputs one by one pays the remote
+    transport's ~100 ms polling floor per output."""
+    for dev, _, _ in reversed(launches):
+        if hasattr(dev, "block_until_ready"):
+            dev.block_until_ready()
+        break
 
 
 def _pallas_ok(b: int) -> bool:
@@ -76,17 +90,25 @@ def _bucket(n: int, buckets) -> int:
 # Host-side packing: wire/host objects -> mont-domain limb arrays
 # ---------------------------------------------------------------------------
 
-def _g1_aff(p: PointG1) -> np.ndarray:
-    x, y = p.to_affine()
+def _g1_xy(xy) -> np.ndarray:
+    x, y = xy
     return np.stack([limb.int_to_mont_limbs(x.v), limb.int_to_mont_limbs(y.v)])
 
 
-def _g2_aff(q: PointG2) -> np.ndarray:
-    x, y = q.to_affine()
+def _g2_xy(xy) -> np.ndarray:
+    x, y = xy
     return np.stack([
         np.stack([limb.int_to_mont_limbs(x.c0), limb.int_to_mont_limbs(x.c1)]),
         np.stack([limb.int_to_mont_limbs(y.c0), limb.int_to_mont_limbs(y.c1)]),
     ])
+
+
+def _g1_aff(p: PointG1) -> np.ndarray:
+    return _g1_xy(p.to_affine())
+
+
+def _g2_aff(q: PointG2) -> np.ndarray:
+    return _g2_xy(q.to_affine())
 
 
 class BatchedEngine:
@@ -107,6 +129,9 @@ class BatchedEngine:
         self._msm_g2_pip = jax.jit(
             lambda pts, bits: curve.pt_to_affine(
                 curve.F2, curve.msm_pippenger(curve.F2, pts, bits)))
+        self._msm_g2_scan = jax.jit(
+            lambda pts, bits: curve.pt_to_affine(
+                curve.F2, curve.msm_scan(curve.F2, pts, bits)))
         self._msg_cache: dict[tuple[bytes, bytes], PointG2] = {}
         # wire-prep: hash-to-curve + decompression + subgroup checks run
         # on the DEVICE (Pallas kernels at bucket >= PALLAS_MIN_BUCKET,
@@ -127,6 +152,7 @@ class BatchedEngine:
         # batches re-chunk to the largest PROVEN bucket.
         self._bucket_ok: dict[int, bool] = {}
         self._wire_ok: dict[int, bool] = {}
+        self._eval_ok: dict[tuple[int, int], bool] = {}
 
     @staticmethod
     def _wire_graph(pub_aff, sig_x, sig_sign, u_pairs):
@@ -173,12 +199,23 @@ class BatchedEngine:
         if ok is not None:
             return ok
         triples = self._known_answer_triples()
-        if b == 1:  # one row per call
-            out = np.concatenate([self._run_bucket(triples[:1], 1),
-                                  self._run_bucket(triples[1:], 1)])
-        else:
-            out = self._run_bucket(triples, b)
-        ok = bool(out[0]) and not bool(out[1])
+        try:
+            if b == 1:  # one row per call
+                out = np.concatenate([self._run_bucket(triples[:1], 1),
+                                      self._run_bucket(triples[1:], 1)])
+                ok = bool(out[0]) and not bool(out[1])
+            else:
+                dev, valid, _ = self._launch_bucket(triples, b)
+                full = np.asarray(dev)
+                # Rows 0/1 are the positive/negative probes; every pad row
+                # is the deterministic generator triple, which verifies
+                # True — the documented axon failure mode is lane-dependent
+                # silent miscompiles, so ALL lanes must match, not just the
+                # probe lanes.
+                ok = (bool(full[0]) and not bool(full[1])
+                      and bool(full[2:].all()) and bool(valid[:2].all()))
+        except Exception:  # noqa: BLE001 — trace/lowering failures too
+            ok = False
         self._bucket_ok[b] = ok
         if not ok:
             from ..utils.logging import default_logger
@@ -188,14 +225,15 @@ class BatchedEngine:
                 reason="known-answer test failed (backend miscompile)")
         return ok
 
-    def _good_bucket(self, n: int, check=None) -> int | None:
+    def _good_bucket(self, n: int, check=None, buckets=None) -> int | None:
         """Smallest validated bucket >= n, else the largest validated one
         (the caller chunks), else None (no trustworthy bucket)."""
         check = check or self._check_bucket
-        for b in self.buckets:
+        buckets = buckets if buckets is not None else self.buckets
+        for b in buckets:
             if b >= n and check(b):
                 return b
-        for b in reversed(self.buckets):
+        for b in reversed(buckets):
             if check(b):
                 return b
         return None
@@ -204,9 +242,14 @@ class BatchedEngine:
         """Batch-verify BLS triples ``(pub: PointG1, sig: PointG2|None,
         msg_point: PointG2)``; a None signature marks an entry already known
         invalid (failed decode). Returns a bool array of len(triples).
-        Batches beyond the largest validated bucket run as multiple device
-        calls; with no validated bucket the engine raises (auto mode falls
-        back to the host path)."""
+
+        Batches beyond the largest validated bucket are dispatched as
+        multiple ASYNC device calls and drained with a single tail sync —
+        a blocking sync through the remote-device transport costs ~100 ms
+        of polling latency regardless of the wait, so per-chunk syncs
+        would serialize the whole batch on host round-trips. With no
+        validated bucket the engine raises (auto mode falls back to the
+        host path)."""
         n = len(triples)
         if n == 0:
             return np.zeros(0, dtype=bool)
@@ -214,12 +257,15 @@ class BatchedEngine:
         if b is None:
             raise RuntimeError(
                 "device engine: no bucket passed known-answer validation")
-        if n > b:
-            return np.concatenate([self.verify_bls(triples[i:i + b])
-                                   for i in range(0, n, b)])
-        return self._run_bucket(triples, b)[:n]
+        launches = [self._launch_bucket(triples[i:i + b], b)
+                    for i in range(0, n, b)]
+        _drain(launches)
+        return np.concatenate([(np.asarray(dev) & valid)[:c]
+                               for dev, valid, c in launches])
 
-    def _run_bucket(self, triples, b: int) -> np.ndarray:
+    def _launch_bucket(self, triples, b: int):
+        """Dispatch one padded bucket; returns (device_out, valid, count)
+        WITHOUT synchronizing — callers drain all launches at once."""
         n = len(triples)
         pubs = np.zeros((b, 2, limb.NLIMBS), np.int32)
         sigs = np.zeros((b, 2, 2, limb.NLIMBS), np.int32)
@@ -228,22 +274,36 @@ class BatchedEngine:
         # pad rows must be well-formed non-infinity points: use g1/g2 bases
         pad_pub, pad_g2 = _g1_aff(PointG1.generator()), _g2_aff(PointG2.generator())
         pubs[:], sigs[:], msgs[:] = pad_pub, pad_g2, pad_g2
+        # one simultaneous inversion for every point in the bucket (the
+        # per-point to_affine inverse dominates host packing otherwise)
+        rows, g1s, g2s = [], [], []
         for i, (pub, sig, msg_pt) in enumerate(triples):
             if sig is None or sig.is_infinity() or pub.is_infinity() \
                     or msg_pt.is_infinity():
                 continue
-            pubs[i], sigs[i], msgs[i] = _g1_aff(pub), _g2_aff(sig), _g2_aff(msg_pt)
+            rows.append(i)
+            g1s.append(pub)
+            g2s.append(sig)
+            g2s.append(msg_pt)
+        g1_xy = PointG1.batch_to_affine(g1s)
+        g2_xy = PointG2.batch_to_affine(g2s)
+        for j, i in enumerate(rows):
+            pubs[i] = _g1_xy(g1_xy[j])
+            sigs[i] = _g2_xy(g2_xy[2 * j])
+            msgs[i] = _g2_xy(g2_xy[2 * j + 1])
             valid[i] = True
         if _pallas_ok(b):
             from . import pallas_pairing
 
-            ok = np.asarray(pallas_pairing.verify_prepared_pl(
-                pubs, sigs, msgs))
+            ok = pallas_pairing.verify_prepared_pl(pubs, sigs, msgs)
         else:
-            ok = np.asarray(self._verify(jnp.asarray(pubs),
-                                         jnp.asarray(sigs),
-                                         jnp.asarray(msgs)))
-        return (ok & valid)[:n]
+            ok = self._verify(jnp.asarray(pubs), jnp.asarray(sigs),
+                              jnp.asarray(msgs))
+        return ok, valid, n
+
+    def _run_bucket(self, triples, b: int) -> np.ndarray:
+        dev, valid, n = self._launch_bucket(triples, b)
+        return (np.asarray(dev) & valid)[:n]
 
     def verify_beacons(self, pubkey: PointG1, beacons,
                        dst: bytes = DEFAULT_DST_G2) -> np.ndarray:
@@ -271,12 +331,13 @@ class BatchedEngine:
                 flat = self.verify_wire(pubkey, checks, dst)
                 return np.array([bool(flat[s:s + c].all())
                                  for s, c in spans])
-            except RuntimeError:
+            except Exception:  # noqa: BLE001 — incl. Mosaic trace/lowering
                 if self.wire_prep:  # explicitly requested: surface it
                     raise
                 # auto mode: wire buckets failed known-answer validation
-                # — fall through to the (still-validated) triples path
-                # rather than the slow host loop
+                # (or the wire graph failed to trace/lower) — fall through
+                # to the (still-validated) triples path rather than the
+                # slow host loop
         triples = []
         spans = []  # (start, count) per beacon
         for bcn in beacons:
@@ -292,6 +353,16 @@ class BatchedEngine:
         flat = self.verify_bls(triples)
         return np.array([bool(flat[s:s + c].all()) for s, c in spans])
 
+    def _wire_buckets(self):
+        """On TPU only Pallas-path sizes: the XLA wire graph at small
+        buckets is the axon stack's flaky regime AND a multi-minute
+        compile — not worth probing mid-batch. CPU runs the XLA graph at
+        any size."""
+        ok = tuple(b for b in self.buckets if b <= WIRE_MAX_BUCKET)
+        if jax.default_backend() == "tpu":
+            ok = tuple(b for b in ok if b >= PALLAS_MIN_BUCKET) or ok[-1:]
+        return ok
+
     def _check_wire_bucket(self, b: int) -> bool:
         ok = self._wire_ok.get(b)
         if ok is not None:
@@ -302,12 +373,23 @@ class BatchedEngine:
         pub = PointG1.generator().mul(sk)
         m = b"engine-wire-bucket-check"
         checks = [(m, bls.sign(sk, m)), (b"other-msg", bls.sign(sk, m))]
-        if b == 1:  # one row per call (same split as _check_bucket)
-            out = np.concatenate([self._run_wire_bucket(pub, checks[:1], 1),
-                                  self._run_wire_bucket(pub, checks[1:], 1)])
-        else:
-            out = self._run_wire_bucket(pub, checks, b)
-        ok = bool(out[0]) and not bool(out[1])
+        try:
+            if b == 1:  # one row per call (same split as _check_bucket)
+                out = np.concatenate(
+                    [self._run_wire_bucket(pub, checks[:1], 1),
+                     self._run_wire_bucket(pub, checks[1:], 1)])
+                ok = bool(out[0]) and not bool(out[1])
+            else:
+                dev, valid, _ = self._launch_wire_bucket(pub, checks, b)
+                full = np.asarray(dev)
+                # pad rows carry the generator as "signature" over the pad
+                # message under this pubkey — they must all verify False
+                # (full-lane check; see _check_bucket)
+                ok = (bool(full[0]) and not bool(full[1])
+                      and not bool(full[2:].any())
+                      and bool(valid[:2].all()))
+        except Exception:  # noqa: BLE001 — trace/lowering failures too
+            ok = False
         self._wire_ok[b] = ok
         if not ok:
             from ..utils.logging import default_logger
@@ -321,22 +403,25 @@ class BatchedEngine:
         """Batch-verify (message bytes, compressed signature) pairs with
         DEVICE-side hashing/decompression/subgroup checks (ops/h2c.py):
         host work is only SHA-256 expansion and byte unpacking. Buckets are
-        known-answer-validated like verify_bls's."""
+        known-answer-validated like verify_bls's; chunks dispatch async
+        with one tail drain (see verify_bls)."""
         n = len(checks)
         if n == 0:
             return np.zeros(0, dtype=bool)
-        b = self._good_bucket(n, check=self._check_wire_bucket)
+        b = self._good_bucket(n, check=self._check_wire_bucket,
+                              buckets=self._wire_buckets())
         if b is None:
             raise RuntimeError(
                 "device engine: no wire bucket passed validation")
-        if n > b:
-            return np.concatenate([self.verify_wire(pubkey, checks[i:i + b],
-                                                    dst)
-                                   for i in range(0, n, b)])
-        return self._run_wire_bucket(pubkey, checks, b, dst)
+        launches = [self._launch_wire_bucket(pubkey, checks[i:i + b], b, dst)
+                    for i in range(0, n, b)]
+        _drain(launches)
+        return np.concatenate([(np.asarray(dev) & valid)[:c]
+                               for dev, valid, c in launches])
 
-    def _run_wire_bucket(self, pubkey: PointG1, checks, b: int,
-                         dst: bytes = DEFAULT_DST_G2) -> np.ndarray:
+    def _launch_wire_bucket(self, pubkey: PointG1, checks, b: int,
+                            dst: bytes = DEFAULT_DST_G2):
+        """Dispatch one padded wire bucket; no sync (see _launch_bucket)."""
         from . import h2c
 
         n = len(checks)
@@ -349,13 +434,19 @@ class BatchedEngine:
         if _pallas_ok(b):
             from . import pallas_wire
 
-            ok = pallas_wire.verify_wire_pl(_g1_aff(pubkey), u, xs, sign)
+            ok = pallas_wire.verify_wire_pl(_g1_aff(pubkey), u, xs, sign,
+                                            sync=False)
         else:
             pubs = np.broadcast_to(_g1_aff(pubkey), (b, 2, limb.NLIMBS))
-            ok = np.asarray(self._verify_wire(
+            ok = self._verify_wire(
                 jnp.asarray(pubs), jnp.asarray(xs), jnp.asarray(sign),
-                jnp.asarray(u)))
-        return (ok & valid)[:n]
+                jnp.asarray(u))
+        return ok, valid, n
+
+    def _run_wire_bucket(self, pubkey: PointG1, checks, b: int,
+                         dst: bytes = DEFAULT_DST_G2) -> np.ndarray:
+        dev, valid, n = self._launch_wire_bucket(pubkey, checks, b, dst)
+        return (np.asarray(dev) & valid)[:n]
 
     def verify_sigs(self, pubkey: PointG1, pairs,
                     dst: bytes = DEFAULT_DST_G2) -> list[bool]:
@@ -379,6 +470,124 @@ class BatchedEngine:
             triples.append((pub_poly.eval(idx).value,
                             _decode_sig(p[tbls.INDEX_BYTES:]), msg_pt))
         return [bool(v) for v in self.verify_bls(triples)]
+
+    # ------------------------------------------------- commitment evals
+    def eval_commits(self, polys, index: int) -> list[PointG1]:
+        """Batched ``PubPoly.eval(index)`` across many commitment
+        polynomials — the DKG deal-verification hot loop
+        (reference kyber vss: one polynomial evaluation per dealer,
+        n per node per DKG round; BASELINE config "n=128 deal verify").
+
+        Device graph: vectorized Horner over the dealer axis — t-1 steps
+        of ([index]·acc + C_k) with the shared small index as a 16-bit
+        double-and-add ladder. Buckets are known-answer-validated per
+        (t, bucket) against the host oracle on deterministic commitments
+        (full-lane check) before first use."""
+        n = len(polys)
+        if n == 0:
+            return []
+        t = len(polys[0].commits)
+        if any(len(p.commits) != t for p in polys):
+            raise ValueError("mixed commitment lengths")
+        if not 0 <= index + 1 < (1 << _EVAL_IDX_BITS):
+            raise ValueError("index out of range")
+        # polynomials carrying a point-at-infinity commitment (legal wire
+        # encoding a malicious dealer can ship) have no affine packing —
+        # evaluate those on the host, the rest on device
+        bad = {i for i, p in enumerate(polys)
+               if any(c.is_infinity() for c in p.commits)}
+        if bad:
+            good = [p for i, p in enumerate(polys) if i not in bad]
+            dev = iter(self.eval_commits(good, index))
+            return [polys[i].eval(index).value if i in bad else next(dev)
+                    for i in range(n)]
+        eb = [b for b in self.buckets if b >= 32] or [128]
+        b = self._good_bucket(n, check=lambda bb: self._check_eval_bucket(
+            t, bb), buckets=eb)
+        if b is None:
+            raise RuntimeError(
+                "device engine: no eval bucket passed validation")
+        # async chunk dispatch, one tail drain (see verify_bls)
+        launches = [self._launch_eval_bucket(polys[i:i + b], index, b)
+                    for i in range(0, n, b)]
+        for dev, _ in reversed(launches):
+            dev[0].block_until_ready()
+            break
+        out = []
+        for dev, cnt in launches:
+            out.extend(self._unpack_eval(dev, cnt))
+        return out
+
+    def _run_eval_bucket(self, polys, index: int, b: int) -> list[PointG1]:
+        dev, n = self._launch_eval_bucket(polys, index, b)
+        return self._unpack_eval(dev, n)
+
+    def _launch_eval_bucket(self, polys, index: int, b: int):
+        t = len(polys[0].commits)
+        n = len(polys)
+        gen = _g1_aff(PointG1.generator())
+        xs = np.zeros((t, b, limb.NLIMBS), np.int32)
+        ys = np.zeros((t, b, limb.NLIMBS), np.int32)
+        xs[:], ys[:] = gen[0], gen[1]
+        flat = PointG1.batch_to_affine(
+            [c for poly in polys for c in poly.commits])
+        for d, poly in enumerate(polys):
+            for k in range(t):
+                aff = _g1_xy(flat[d * t + k])
+                xs[k, d], ys[k, d] = aff[0], aff[1]
+        # evaluation abscissa is index + 1 (kyber share convention —
+        # crypto/poly._x_of)
+        bits = curve.scalar_to_bits(index + 1, _EVAL_IDX_BITS)
+        dev = _eval_commits_graph(
+            jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(bits), t=t)
+        return dev, n
+
+    @staticmethod
+    def _unpack_eval(dev, n: int) -> list[PointG1]:
+        from ..crypto.fields import Fp
+
+        ax, ay, inf = (np.asarray(c) for c in dev)
+        out = []
+        for d in range(n):
+            if inf[d]:
+                out.append(PointG1.infinity())
+            else:
+                out.append(PointG1(Fp(limb.fp_from_device(ax[d])),
+                                   Fp(limb.fp_from_device(ay[d])),
+                                   Fp(1)))
+        return out
+
+    def _check_eval_bucket(self, t: int, b: int) -> bool:
+        key = (t, b)
+        ok = self._eval_ok.get(key)
+        if ok is not None:
+            return ok
+        g = PointG1.generator()
+        polys = [PubPoly([g.mul(1 + 31 * d + k) for k in range(t)])
+                 for d in range(min(3, b))]
+        index = 5
+        try:
+            got = self._run_eval_bucket(polys, index, b)
+            expect = [p.eval(index).value for p in polys]
+            ok = all(a == e for a, e in zip(got, expect))
+            if ok and b > len(polys):
+                # full-lane check: pad rows are constant generator
+                # polynomials, eval = [sum((index+1)^k)] * g  (the
+                # abscissa is index + 1 — crypto/poly._x_of)
+                s = sum((index + 1) ** k for k in range(t))
+                pad_expect = g.mul(s)
+                pads = self._run_eval_bucket(
+                    [PubPoly([g] * t)] * b, index, b)
+                ok = all(p == pad_expect for p in pads)
+        except Exception:  # noqa: BLE001 — trace/lowering failures too
+            ok = False
+        self._eval_ok[key] = ok
+        if not ok:
+            from ..utils.logging import default_logger
+
+            default_logger("engine").warn(
+                "engine", "eval_bucket_disabled", t=t, bucket=b)
+        return ok
 
     # ------------------------------------------------------------ recover
     def recover(self, pub_poly: PubPoly, msg: bytes, partials, t: int, n: int,
@@ -418,8 +627,14 @@ class BatchedEngine:
         z_one[:, 0] = np.asarray(limb.ONE_MONT)
         pts = (jnp.asarray(pts_np[:, 0]), jnp.asarray(pts_np[:, 1]),
                jnp.asarray(z_one), jnp.asarray(inf))
-        msm_fn = (self._msm_g2_pip if b >= self.PIPPENGER_MIN_T
-                  else self._msm_g2)
+        if jax.default_backend() == "tpu" and b > self.PIPPENGER_MIN_T:
+            # compile-friendly path: the unrolled ladder/window graphs
+            # take >10 min to build at b=128 on the XLA limb path; the
+            # one-per-round recovery is latency-tolerant (see msm_scan)
+            msm_fn = self._msm_g2_scan
+        else:
+            msm_fn = (self._msm_g2_pip if b >= self.PIPPENGER_MIN_T
+                      else self._msm_g2)
         x_aff, y_aff, is_inf = msm_fn(pts, jnp.asarray(bits))
         if bool(np.asarray(is_inf)):
             raise ValueError("recovered signature is the point at infinity")
@@ -431,6 +646,38 @@ class BatchedEngine:
             Fp2.one(),
         )
         return rec.to_bytes()
+
+
+# index width for the eval_commits ladder (node indices are tiny; 10 bits
+# covers groups up to n=1022 with one jit shape)
+_EVAL_IDX_BITS = 10
+
+import functools as _functools
+
+
+@_functools.partial(jax.jit, static_argnames=("t",))
+def _eval_commits_graph(xs, ys, bits, t: int):
+    """Vectorized Horner: eval_d = C[d,t-1]; repeat (·index, +C[d,k]).
+    xs/ys: (t, b, NLIMBS) affine mont limbs (generator in pad lanes);
+    bits: (_EVAL_IDX_BITS,) MSB-first shared index bits. The Horner steps
+    run under lax.scan (one compiled body) — an unrolled loop's HLO count
+    scales with t and stalls XLA compilation."""
+    F = curve.F1
+    b = xs.shape[1]
+    z_one = jnp.broadcast_to(jnp.asarray(limb.ONE_MONT), (b, limb.NLIMBS))
+    no_inf = jnp.zeros((b,), bool)
+
+    def body(acc, c):
+        cx, cy = c
+        acc = curve.pt_mul_bits(F, acc, bits)
+        acc = curve.pt_add(F, acc, (cx, cy, z_one, no_inf))
+        return acc, None
+
+    acc0 = (xs[t - 1], ys[t - 1], z_one, no_inf)
+    acc, _ = jax.lax.scan(
+        body, acc0, (jnp.flip(xs[:t - 1], axis=0),
+                     jnp.flip(ys[:t - 1], axis=0)))
+    return curve.pt_to_affine(F, acc)
 
 
 _PAD_SIG_BYTES: bytes | None = None

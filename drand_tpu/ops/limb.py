@@ -182,17 +182,20 @@ def reduce_limbs(t: jnp.ndarray, passes: int = 2, pre_rounds: int = 2) -> jnp.nd
 
 def reduce_light(t: jnp.ndarray) -> jnp.ndarray:
     """Normalization for SMALL overflows (limbs < 2^16 — add/sub/mul_small
-    outputs): one fold round, then two wrap passes with 2-round folds.
+    outputs): one fold round, then THREE wrap passes with 2-round folds.
 
-    The second wrap pass is load-bearing: after one pass the value can still
-    exceed 2^384 by up to ~hi*delta (delta = 2^384 mod p), and truncating
-    that carry limb is a real ~0.4%-of-random-inputs bug (caught by fuzz).
-    Pass 2 maps the residue back under 2^384 with provable margin:
-    V'' = (V' - 2^384) + delta < 0.007 * 2^384, so its carry-out is 0.
-    Roughly half the jit-graph size of reduce_limbs — adds dominate the
-    tower's op count, so this is compile-time critical."""
+    The third pass is load-bearing. Soundness (w0 = 2^384 mod p ≈
+    0.086·2^384): the initial fold leaves a carry limb t32 ≤ 16, so after
+    pass 1 the value can be as large as V1 ≤ (1.004 + 16·0.086)·2^384 ≈
+    2.4·2^384; after pass 2 it is V2 ≤ (1.004 + 2·0.086)·2^384 ≈
+    1.18·2^384 — still ≥ 2^384, so a 2-pass wrap can end with a NONZERO
+    carry limb that truncation silently drops (a −2^384 ≡ −R error; found
+    as a live ~2^-12-per-sub bug via a failing pairing witness,
+    tests/test_limb_regression.py). After pass 3, V3 ≤ (0.18 + 0.086)·
+    2^384 < 2^384, so the final carry limb is provably zero and the
+    truncation is exact."""
     t = _fold(t, rounds=1, grow=True)
-    return _wrap(t, passes=2, fold_rounds=2)
+    return _wrap(t, passes=3, fold_rounds=2)
 
 
 # ---------------------------------------------------------------------------

@@ -388,6 +388,179 @@ def _is_one_kernel(c_ref, a4_ref, m_ref, o_ref):
 PM2_FLAT = bl._PM2_ROWS.reshape(1, 384)
 
 
+# ---------------------------------------------------------------------------
+# Grid kernels — one Miller/pow iteration per grid step, batch-blocked.
+#
+# The single-fori_loop kernels above compile to poor code when the loop
+# body is large (measured 15M fp-mul/s inside _miller_kernel vs 157M for
+# a lean chain kernel at the same batch — Mosaic register allocation
+# degrades with body size). Re-expressing the outer loop as a Pallas grid
+# dimension gives each step a small body and measured ~5x on the Miller
+# loop (96.7 -> 19.5 ms at B=128, bit-identical output). The grid's
+# leading dimension blocks the batch at BB lanes, so any B = k*BB runs
+# in bounded VMEM; scratch state persists across the inner iteration
+# steps and is re-initialised at step 0 of every batch block.
+# ---------------------------------------------------------------------------
+
+GRID_BLOCK = 128  # lanes per batch block (the VPU-native lane width)
+
+
+def _miller_grid_kernel(flags_ref, c_ref, xp_ref, yp_ref, q_ref, o_ref,
+                        f_ref, tx_ref, ty_ref, tz_ref):
+    """One Miller iteration per inner grid step; batch blocks outer."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(1)
+    with bl.const_context(c_ref[:]):
+        xp, yp, q = xp_ref[:], yp_ref[:], q_ref[:]
+        npairs = q.shape[0]
+        b = q.shape[-1]
+        xq, yq = q[..., 0, :, :, :], q[..., 1, :, :, :]
+
+        @pl.when(i == 0)
+        def _init():
+            one_fp = jnp.broadcast_to(
+                bl._crow("ONE"), xq.shape[:-3] + (NLIMBS, b)).astype(DTYPE)
+            f_ref[:] = f12_one((), b)
+            tx_ref[:] = xq
+            ty_ref[:] = yq
+            tz_ref[:] = jnp.stack([one_fp, jnp.zeros_like(one_fp)], axis=-3)
+
+        f = f12_sqr(f_ref[:])
+        T, lines = _dbl_step((tx_ref[:], ty_ref[:], tz_ref[:]), xp, yp)
+        f_ref[:] = _sparse_mul_035(f, lines, npairs, split=True)
+        tx_ref[:], ty_ref[:], tz_ref[:] = T
+
+        @pl.when(flags_ref[i] != 0)
+        def _add():
+            Ta, lines_a = _add_step(
+                (tx_ref[:], ty_ref[:], tz_ref[:]), q, xp, yp)
+            f_ref[:] = _sparse_mul_035(f_ref[:], lines_a, npairs,
+                                       split=True)
+            tx_ref[:], ty_ref[:], tz_ref[:] = Ta
+
+        @pl.when(i == pl.num_programs(1) - 1)
+        def _fin():
+            o_ref[:] = f12_conj(f_ref[:])
+
+
+def _pow_grid_kernel(bits_ref, c_ref, m_ref, o_ref, acc_ref):
+    """One cyclotomic square (+masked multiply) per inner grid step:
+    computes m^(-|e|) like _pow_kernel."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(1)
+    with bl.const_context(c_ref[:]):
+        @pl.when(i == 0)
+        def _init():
+            acc_ref[:] = f12_one((), m_ref.shape[-1])
+
+        acc_ref[:] = f12_cyclotomic_sqr(acc_ref[:])
+
+        @pl.when(bits_ref[i] != 0)
+        def _mul():
+            acc_ref[:] = f12_mul(acc_ref[:], f12_conj(m_ref[:]))
+
+        @pl.when(i == pl.num_programs(1) - 1)
+        def _fin():
+            o_ref[:] = acc_ref[:]
+
+
+def _easy_grid_kernel(pm2_ref, c_ref, f_ref, o_ref):
+    """Easy part over one batch block per grid step."""
+    with bl.const_context(c_ref[:]):
+        o_ref[:] = final_exp_easy_bl(
+            f_ref[:], bit_getter=lambda i: pm2_ref[i])
+
+
+def _mul_frob1_grid_kernel(c_ref, x_ref, y_ref, o_ref):
+    with bl.const_context(c_ref[:]):
+        o_ref[:] = f12_mul(x_ref[:], f12_frobenius(y_ref[:], 1))
+
+
+def _a4_grid_kernel(c_ref, x_ref, y_ref, o_ref):
+    with bl.const_context(c_ref[:]):
+        o_ref[:] = f12_mul(f12_mul(x_ref[:], f12_frobenius(y_ref[:], 2)),
+                           f12_conj(y_ref[:]))
+
+
+def _is_one_grid_kernel(c_ref, a4_ref, m_ref, o_ref):
+    with bl.const_context(c_ref[:]):
+        m = m_ref[:]
+        out = f12_mul(a4_ref[:], f12_mul(m, f12_cyclotomic_sqr(m)))
+        ok = bl.f12_is_one(out)
+        o_ref[:] = jnp.broadcast_to(ok.astype(DTYPE)[None, :], o_ref.shape)
+
+
+def _block_last(shape, bb):
+    """Full-array block except the lane axis blocked at bb; index_map
+    keeps every axis at block 0 and walks the lane axis by batch block."""
+    block = shape[:-1] + (bb,)
+    nd = len(shape)
+
+    def imap(bi, i, *_):
+        return (0,) * (nd - 1) + (bi,)
+
+    return block, imap
+
+
+@functools.partial(jax.jit, static_argnames=("npairs", "b", "bb"))
+def _verify_pl_grid(xp, yp, q, npairs: int, b: int, bb: int = GRID_BLOCK):
+    """Grid-kernel verify chain: same mathematics and contract as
+    _verify_pl, restructured as batch-blocked iteration grids. Requires
+    b % bb == 0."""
+    assert b % bb == 0, (b, bb)
+    nb = b // bb
+    consts = jnp.asarray(bl.CONST_BUFFER)
+    cshape = bl.CONST_BUFFER.shape
+    f12_shape = jax.ShapeDtypeStruct((2, 3, 2, NLIMBS, b), DTYPE)
+    f12_block = (2, 3, 2, NLIMBS, bb)
+    f12_dims = f12_block
+    t_dims = (npairs, 2, NLIMBS, bb)
+
+    def cmap(bi, i, *_):
+        return (0, 0)
+
+    def run(kernel, n_inner, scalars, ins, scratch, out_shape=f12_shape,
+            out_block=None):
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        out_block = out_block or _block_last(out_shape.shape, bb)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=len(scalars),
+            grid=(nb, n_inner),
+            in_specs=[pl.BlockSpec(cshape, cmap)] + [
+                pl.BlockSpec(*_block_last(a.shape, bb)) for a in ins],
+            out_specs=pl.BlockSpec(*out_block),
+            scratch_shapes=[pltpu.VMEM(s, DTYPE) for s in scratch],
+        )
+        return pl.pallas_call(kernel, out_shape=out_shape,
+                              grid_spec=grid_spec)(*scalars, consts, *ins)
+
+    flags = jnp.asarray(MILLER_FLAGS[0].astype(np.int32))
+    pm2 = jnp.asarray(PM2_FLAT[0].astype(np.int32))
+    bits_xm1 = jnp.asarray(BITS_XM1[0].astype(np.int32))
+    bits_x = jnp.asarray(BITS_X[0].astype(np.int32))
+
+    f = run(_miller_grid_kernel, N_MILLER, (flags,), (xp, yp, q),
+            (f12_dims, t_dims, t_dims, t_dims))
+    m = run(_easy_grid_kernel, 1, (pm2,), (f,), ())
+
+    def pow_neg(x, bits, nbits):
+        return run(_pow_grid_kernel, nbits, (bits,), (x,), (f12_dims,))
+
+    a1 = pow_neg(m, bits_xm1, N_XM1)
+    a2 = pow_neg(a1, bits_xm1, N_XM1)
+    a3 = run(_mul_frob1_grid_kernel, 1, (),
+             (pow_neg(a2, bits_x, N_X), a2), ())
+    t = pow_neg(a3, bits_x, N_X)
+    a4 = run(_a4_grid_kernel, 1, (), (pow_neg(t, bits_x, N_X), a3), ())
+    ok = run(_is_one_grid_kernel, 1, (), (a4, m),
+             (), out_shape=jax.ShapeDtypeStruct((8, b), DTYPE))
+    return ok[0] != 0
+
+
 @functools.partial(jax.jit, static_argnames=("npairs", "b"))
 def _verify_pl(xp, yp, q, npairs: int, b: int):
     """Full BLS batch check with ALL per-element math inside Pallas
@@ -470,11 +643,15 @@ def _neg_g1_np():
 def verify_prepared_pl(pub_aff, sig_aff, msg_aff, use_pallas: bool = True):
     """Batched BLS verify — same contract as ops/pairing.verify_prepared
     (e(-g1, sig) * e(pub, H(msg)) == 1 per batch row) on the batch-last
-    Pallas path. Inputs in the engine's batch-leading layout."""
+    Pallas path. Inputs in the engine's batch-leading layout. Batches
+    that are a multiple of GRID_BLOCK take the grid-kernel chain (~5x
+    the fused-fori kernels); others keep the fused kernels."""
     xp, yp, q = pack_verify_inputs(np.asarray(pub_aff), np.asarray(sig_aff),
                                    np.asarray(msg_aff))
     b = q.shape[-1]
     if use_pallas:
+        if b % GRID_BLOCK == 0:
+            return _verify_pl_grid(xp, yp, q, npairs=2, b=b)
         return _verify_pl(xp, yp, q, npairs=2, b=b)
     return _f12_is_one_bl(_multi_pairing_jit(xp, yp, q))
 

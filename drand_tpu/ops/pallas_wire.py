@@ -198,10 +198,13 @@ def _wire_verify_pl(pub_xp, pub_yp, u_pairs, sig_x, sign_mask, b: int):
     return pair_ok & (sig_ok[0] != 0) & (minf[0] == 0)
 
 
-def verify_wire_pl(pubkey_aff, u_pairs_np, sig_x_np, sign_np) -> np.ndarray:
+def verify_wire_pl(pubkey_aff, u_pairs_np, sig_x_np, sign_np,
+                   sync: bool = True):
     """Host entry: pubkey_aff (2, 32) mont limbs; u_pairs_np (B, 2, 2, 32)
     batch-leading (ops/h2c.msgs_to_u layout); sig_x_np (B, 2, 32); sign_np
-    (B,) bool. Returns (B,) bool."""
+    (B,) bool. Returns (B,) bool — as numpy when ``sync`` (the default),
+    else the un-synced device array so callers can pipeline chunks and
+    drain once."""
     b = u_pairs_np.shape[0]
     u_bl = jnp.asarray(np.moveaxis(u_pairs_np, 0, -1))  # (2, 2, 32, B)
     sig_bl = jnp.asarray(np.moveaxis(sig_x_np, 0, -1))  # (2, 32, B)
@@ -211,5 +214,5 @@ def verify_wire_pl(pubkey_aff, u_pairs_np, sig_x_np, sign_np) -> np.ndarray:
                                          (NLIMBS, b)))
     pub_yp = jnp.asarray(np.broadcast_to(pubkey_aff[1][:, None],
                                          (NLIMBS, b)))
-    return np.asarray(_wire_verify_pl(pub_xp, pub_yp, u_bl, sig_bl,
-                                      sign_mask, b))
+    out = _wire_verify_pl(pub_xp, pub_yp, u_bl, sig_bl, sign_mask, b)
+    return np.asarray(out) if sync else out

@@ -1,0 +1,119 @@
+"""Batched commitment evaluation (DKG deal verification) and the
+scan-MSM — device paths vs the host oracle.
+
+Reference: kyber vss deal verification (g·s_i == Σ_k C_k·x^k), the
+BASELINE "n=128 deal verify" config; engine.eval_commits is the device
+call the DKG's _process_deals batches into.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from drand_tpu.crypto import batch
+from drand_tpu.crypto.curves import PointG1, PointG2
+from drand_tpu.crypto.fields import R
+from drand_tpu.crypto.poly import PriPoly, PubPoly
+
+
+@pytest.fixture
+def engine():
+    from drand_tpu.ops.engine import BatchedEngine
+
+    return BatchedEngine()
+
+
+def test_eval_commits_matches_host(engine):
+    rnd = random.Random(7)
+    g = PointG1.generator()
+    t, n = 5, 40
+    polys = [PubPoly([g.mul(rnd.randrange(1, 2 ** 64)) for _ in range(t)])
+             for _ in range(n)]
+    idx = 11
+    got = engine.eval_commits(polys, idx)
+    exp = [p.eval(idx).value for p in polys]
+    assert got == exp
+
+
+def test_eval_commits_share_check_roundtrip(engine):
+    # the actual DKG use: dealer polys, our decrypted share, g·s == eval
+    t, n, my_index = 4, 9, 2
+    pris = [PriPoly.random(t, seed=b"ec-%d" % d) for d in range(n)]
+    pubs = [p.commit() for p in pris]
+    shares = [p.eval(my_index).value for p in pris]
+    evals = engine.eval_commits(pubs, my_index)
+    g = PointG1.generator()
+    assert all(g.mul(s) == e for s, e in zip(shares, evals))
+    # a corrupted share must not check out
+    assert g.mul((shares[0] + 1) % R) != evals[0]
+
+
+def test_eval_commits_via_batch_dispatch():
+    prev = batch._MODE, batch._MIN_BATCH
+    try:
+        batch.configure("device", min_batch=1)
+        g = PointG1.generator()
+        polys = [PubPoly([g.mul(3 + d + k) for k in range(3)])
+                 for d in range(6)]
+        got = batch.eval_commits(polys, 1)
+        assert got == [p.eval(1).value for p in polys]
+    finally:
+        batch.configure(prev[0], min_batch=prev[1])
+
+
+def test_msm_scan_matches_unrolled():
+    import jax.numpy as jnp
+
+    from drand_tpu.ops import curve, limb
+    from drand_tpu.ops.engine import _g2_aff
+    from drand_tpu.crypto.fields import Fp2
+
+    rnd = random.Random(3)
+    n = 5
+    pts_h = [PointG2.generator().mul(rnd.randrange(1, R)) for _ in range(n)]
+    scals = [rnd.randrange(R) for _ in range(n)]
+    exp = None
+    for p, s in zip(pts_h, scals):
+        q = p.mul(s)
+        exp = q if exp is None else exp + q
+    pts_np = np.stack([_g2_aff(p) for p in pts_h])
+    z_one = np.zeros((n, 2, limb.NLIMBS), np.int32)
+    z_one[:, 0] = np.asarray(limb.ONE_MONT)
+    bits = np.stack([curve.scalar_to_bits(s, 255) for s in scals])
+    pts = (jnp.asarray(pts_np[:, 0]), jnp.asarray(pts_np[:, 1]),
+           jnp.asarray(z_one), jnp.asarray(np.zeros(n, bool)))
+    ax, ay, is_inf = curve.pt_to_affine(
+        curve.F2, curve.msm_scan(curve.F2, pts, jnp.asarray(bits)))
+    got = PointG2(
+        Fp2(limb.fp_from_device(np.asarray(ax)[0]),
+            limb.fp_from_device(np.asarray(ax)[1])),
+        Fp2(limb.fp_from_device(np.asarray(ay)[0]),
+            limb.fp_from_device(np.asarray(ay)[1])),
+        Fp2.one())
+    assert not bool(np.asarray(is_inf))
+    assert got == exp
+
+
+def test_verify_bls_async_chunking(engine):
+    """Batches beyond the largest bucket dispatch as multiple async
+    launches and drain once — results must match per-row truth."""
+    from drand_tpu.crypto import bls
+    from drand_tpu.crypto.hash_to_curve import hash_to_g2
+
+    sk = 0xBEE
+    pub = PointG1.generator().mul(sk)
+    triples = []
+    want = []
+    for i in range(11):
+        m = b"chunk-%d" % i
+        sig = PointG2.from_bytes(bls.sign(sk, m), subgroup_check=False)
+        if i % 3 == 2:  # wrong message for this signature
+            triples.append((pub, sig, hash_to_g2(b"other")))
+            want.append(False)
+        else:
+            triples.append((pub, sig, hash_to_g2(m)))
+            want.append(True)
+    small = type(engine)(buckets=(4,))
+    out = small.verify_bls(triples)
+    assert list(out) == want

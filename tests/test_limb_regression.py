@@ -1,0 +1,108 @@
+"""Regression witnesses for the limb-arithmetic reduction soundness bug.
+
+Round-3 find: ``reduce_light`` (both ops/limb.py and ops/bl.py) ran only
+TWO wrap passes; with lazy-carry inputs from ``sub`` the value after pass
+2 can still exceed 2^384, and ``_wrap`` truncates the live carry limb —
+silently subtracting 2^384 (≡ −R mod p) from the result. Hit probability
+is ~2^-12 per sub of non-canonical operands, i.e. roughly 1% of pairings;
+the concrete witness below came from a FAILING valid BLS verification
+(message b"pack-126" under sk 0x77: the Miller loop's sparse multiply at
+iteration 41 produced c1 short by exactly 1).
+
+The fix is a third wrap pass with a proved value bound (see
+limb.reduce_light docstring).
+"""
+
+import numpy as np
+
+from drand_tpu.crypto.fields import Fp2, P
+from drand_tpu.ops import bl, limb
+
+# device-representation (lazy-carry, Montgomery-domain) Fp2 operands
+# captured from the failing pairing — limbs of (c0, c1), 12-bit radix
+A = [[3461, 2515, 2759, 2235, 118, 2074, 2474, 3336, 979, 3400, 613, 1831,
+      1542, 50, 480, 789, 1219, 1623, 3427, 3724, 5, 1514, 3687, 1802,
+      2551, 3429, 1921, 2576, 3515, 195, 14, 1720],
+     [1365, 2066, 3417, 3684, 3327, 3236, 2642, 2046, 230, 2880, 956, 1158,
+      801, 3865, 147, 99, 1343, 1271, 4040, 349, 1166, 776, 594, 3550,
+      1339, 2897, 3043, 3619, 3879, 1805, 328, 3142]]
+B = [[860, 4066, 1373, 3047, 3051, 2449, 3963, 3164, 3415, 3149, 4064, 126,
+      3653, 3055, 1142, 3530, 565, 1965, 2348, 2696, 2099, 2809, 1985,
+      3006, 3344, 598, 340, 934, 303, 4038, 1453, 961],
+     [1208, 3656, 2099, 1926, 3540, 3081, 2570, 2415, 2752, 2232, 2685,
+      2872, 1780, 2714, 295, 1034, 314, 273, 2609, 3411, 2539, 1690, 543,
+      1636, 3530, 1661, 3809, 2440, 1042, 3741, 2803, 699]]
+
+R_INV = pow(1 << 384, -1, P)
+
+
+def _val(limbs) -> int:
+    return sum(int(v) << (12 * i) for i, v in enumerate(limbs))
+
+
+def _fp2_of(rows) -> Fp2:
+    # device arrays are Montgomery-domain: value = limbs / R mod p
+    return Fp2(_val(rows[0]) * R_INV % P, _val(rows[1]) * R_INV % P)
+
+
+def test_f2_mul_witness_bl():
+    a_np = np.asarray(A, np.int32)[:, :, None]
+    b_np = np.asarray(B, np.int32)[:, :, None]
+    out = np.asarray(bl.f2_mul(a_np, b_np))
+    got = Fp2(limb.fp_from_device(out[0, :, 0]) % P,
+              limb.fp_from_device(out[1, :, 0]) % P)
+    exp = _fp2_of(A) * _fp2_of(B)
+    assert got == exp
+
+
+def test_f2_mul_witness_limb_path():
+    from drand_tpu.ops import tower
+
+    # limb-last layout: (..., 2, 32)
+    a_np = np.asarray(A, np.int32)
+    b_np = np.asarray(B, np.int32)
+    out = np.asarray(tower.f2_mul(a_np, b_np))
+    got = Fp2(limb.fp_from_device(out[0]) % P,
+              limb.fp_from_device(out[1]) % P)
+    exp = _fp2_of(A) * _fp2_of(B)
+    assert got == exp
+
+
+def test_sub_then_wrap_carry_edge():
+    """The distilled core: sub() whose reduce_light needs the third wrap
+    pass. v2 - (v0 + v1) with the witness products."""
+    a_np = np.asarray(A, np.int32)[:, :, None]
+    b_np = np.asarray(B, np.int32)[:, :, None]
+    v0 = np.asarray(bl.mont_mul(a_np[0], b_np[0]))
+    v1 = np.asarray(bl.mont_mul(a_np[1], b_np[1]))
+    sa = np.asarray(bl.add(a_np[0], a_np[1]))
+    sb = np.asarray(bl.add(b_np[0], b_np[1]))
+    v2 = np.asarray(bl.mont_mul(sa, sb))
+    c1 = np.asarray(bl.sub(v2, bl.add(v0, v1)))
+    got = limb.fp_from_device(c1[:, 0]) % P
+    a2, b2 = _fp2_of(A), _fp2_of(B)
+    exp = (a2 * b2).c1
+    assert got == exp
+
+
+def test_randomized_chain_against_host():
+    """Chained f2 ops on random values, compared against the host field —
+    broad fuzz over the non-canonical representation space."""
+    import random
+
+    rnd = random.Random(0xD1CE)
+    for trial in range(20):
+        av = Fp2(rnd.randrange(P), rnd.randrange(P))
+        bv = Fp2(rnd.randrange(P), rnd.randrange(P))
+        cv = Fp2(rnd.randrange(P), rnd.randrange(P))
+        a_np = np.stack([bl.pack_fp([av.c0]), bl.pack_fp([av.c1])])
+        b_np = np.stack([bl.pack_fp([bv.c0]), bl.pack_fp([bv.c1])])
+        c_np = np.stack([bl.pack_fp([cv.c0]), bl.pack_fp([cv.c1])])
+        # (a*b + c)^2 - a*c, all in non-canonical chained representation
+        t = bl.f2_add(bl.f2_mul(a_np, b_np), c_np)
+        t = bl.f2_sub(bl.f2_sqr(t), bl.f2_mul(a_np, c_np))
+        out = np.asarray(t)
+        got = Fp2(limb.fp_from_device(out[0, :, 0]) % P,
+                  limb.fp_from_device(out[1, :, 0]) % P)
+        exp = (av * bv + cv).square() - av * cv
+        assert got == exp, f"trial {trial}"

@@ -1,0 +1,236 @@
+"""Bisect the device Miller loop against a host big-int simulation of the
+SAME formulas (value level, mod p) to locate a wrong operation.
+
+Usage: PYTHONPATH= JAX_PLATFORMS=cpu python tools/debug_miller.py [msg-id]
+"""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from drand_tpu.utils.jit_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from drand_tpu.crypto import bls
+from drand_tpu.crypto.curves import PointG1, PointG2
+from drand_tpu.crypto.hash_to_curve import hash_to_g2
+from drand_tpu.crypto.fields import Fp2, P
+from drand_tpu.ops import pallas_pairing as pp, limb
+from drand_tpu.ops.engine import _g1_aff, _g2_aff
+
+XI = None  # placeholder
+
+
+def xi_mul(a: Fp2) -> Fp2:
+    return Fp2((a.c0 - a.c1) % P, (a.c0 + a.c1) % P)
+
+
+def s_mul(a: Fp2, k: int) -> Fp2:
+    return Fp2(a.c0 * k % P, a.c1 * k % P)
+
+
+def fp_mul(a: Fp2, s: int) -> Fp2:
+    return Fp2(a.c0 * s % P, a.c1 * s % P)
+
+
+def dbl_step(T, xp, yp):
+    X, Y, Z = T
+    X2 = X.square(); Y2 = Y.square(); Z2 = Z.square()
+    Z3 = Z2 * Z; YZ3 = Y * Z3
+    lam_s = s_mul(X2 * Z2, 3)
+    c0 = xi_mul(fp_mul(s_mul(YZ3, 2), yp))
+    c5 = -fp_mul(lam_s, xp)
+    X3cu = X2 * X
+    c3 = s_mul(X3cu, 3) - s_mul(Y2, 2)
+    C = Y2.square()
+    D = s_mul((X + Y2).square() - (X2 + C), 2)
+    E = s_mul(X2, 3)
+    F = E.square()
+    Xn = F - s_mul(D, 2)
+    Yn = E * (D - Xn) - s_mul(C, 8)
+    Zn = s_mul(Y * Z, 2)
+    return (Xn, Yn, Zn), (c0, c3, c5)
+
+
+def add_step(T, q, xp, yp):
+    X, Y, Z = T
+    xq, yq = q
+    Z2 = Z.square(); Z3 = Z2 * Z
+    U2 = xq * Z2; S2 = yq * Z3
+    H = U2 - X; M = S2 - Y
+    HZ = H * Z
+    c0 = xi_mul(fp_mul(HZ, yp))
+    c5 = -fp_mul(M, xp)
+    c3 = M * xq - HZ * yq
+    HH = H.square(); HHH = HH * H
+    V = X * HH
+    M2 = M.square()
+    Xn = M2 - (HHH + s_mul(V, 2))
+    Yn = M * (V - Xn) - Y * HHH
+    Zn = Z * H
+    return (Xn, Yn, Zn), (c0, c3, c5)
+
+
+def f12_to_w(c):  # c: dict (c1,c6,c2)->int; here keep as list of 6 Fp2
+    return c
+
+
+def sparse_mul(fw, lines):
+    """fw: list of 6 Fp2 (w-basis); lines: (c0, c3, c5) per pair folded
+    sequentially."""
+    for (c0, c3, c5) in lines:
+        p0 = [w * c0 for w in fw]
+        p3 = [w * c3 for w in fw]
+        p5 = [w * c5 for w in fw]
+        out = []
+        for k in range(6):
+            t = p0[k]
+            t3 = p3[(k - 3) % 6]
+            if k - 3 < 0:
+                t3 = xi_mul(t3)
+            t5 = p5[(k - 5) % 6]
+            if k - 5 < 0:
+                t5 = xi_mul(t5)
+            out.append(t + t3 + t5)
+        fw = out
+    return fw
+
+
+def w_sqr(fw):
+    """f^2 in the w-basis via schoolbook with w^6 = xi (M-twist tower:
+    w^2 = v, v^3 = xi)."""
+    out = [Fp2.zero() for _ in range(6)]
+    for i in range(6):
+        for j in range(6):
+            t = fw[i] * fw[j]
+            k = i + j
+            if k >= 6:
+                t = xi_mul(t)
+                k -= 6
+            out[k] = out[k] + t
+    return out
+
+
+def run_host(xps, yps, qs, n_iter):
+    """Simulate the bl miller loop for npairs pairs at VALUE level."""
+    npairs = len(qs)
+    fw = [Fp2.one()] + [Fp2.zero()] * 5
+    Ts = [(qs[i][0], qs[i][1], Fp2.one()) for i in range(npairs)]
+    flags = pp.MILLER_FLAGS[0]
+    for it in range(n_iter):
+        fw = w_sqr(fw)
+        lines = []
+        for i in range(npairs):
+            Ts[i], ln = dbl_step(Ts[i], xps[i], yps[i])
+            lines.append(ln)
+        fw = sparse_mul(fw, lines)
+        if flags[it]:
+            lines = []
+            for i in range(npairs):
+                Ts[i], ln = add_step(Ts[i], qs[i], xps[i], yps[i])
+                lines.append(ln)
+            fw = sparse_mul(fw, lines)
+    return fw, Ts
+
+
+def device_partial(xp, yp, q, n_iter):
+    def run(xp, yp, q):
+        npairs = q.shape[0]
+        b = q.shape[-1]
+        xq, yq = q[..., 0, :, :, :], q[..., 1, :, :, :]
+        from drand_tpu.ops import bl
+        from drand_tpu.ops.bl import NLIMBS, DTYPE
+        one_fp = jnp.broadcast_to(bl._crow("ONE"),
+                                  xq.shape[:-3] + (NLIMBS, b)).astype(DTYPE)
+        one2 = jnp.stack([one_fp, jnp.zeros_like(one_fp)], axis=-3)
+        f0 = bl.f12_one((), b)
+        getter = pp.value_bit_getter(jnp.asarray(pp.MILLER_FLAGS))
+
+        def body(i, state):
+            f, X, Y, Z = state
+            f = bl.f12_sqr(f)
+            (X, Y, Z), lines = pp._dbl_step((X, Y, Z), xp, yp)
+            f = pp._sparse_mul_035(f, lines, npairs)
+            (Xa, Ya, Za), lines_a = pp._add_step((X, Y, Z), q, xp, yp)
+            fa = pp._sparse_mul_035(f, lines_a, npairs)
+            cond = getter(i) != 0
+            f = jnp.where(cond, fa, f)
+            X = jnp.where(cond, Xa, X)
+            Y = jnp.where(cond, Ya, Y)
+            Z = jnp.where(cond, Za, Z)
+            return f, X, Y, Z
+
+        return jax.lax.fori_loop(0, n_iter, body, (f0, xq, yq, one2))
+
+    return jax.jit(run)(xp, yp, q)
+
+
+def unpack_f2(arr):  # (2, 32, 1)
+    return Fp2(limb.fp_from_device(arr[0, :, 0]) % P,
+               limb.fp_from_device(arr[1, :, 0]) % P)
+
+
+def main(mi=126):
+    sk = 0x77
+    pub = PointG1.generator().mul(sk)
+    m = b"pack-%d" % mi
+    sig = PointG2.from_bytes(bls.sign(sk, m), subgroup_check=False)
+    h = hash_to_g2(m)
+    pubs = _g1_aff(pub)[None]
+    sigs = _g2_aff(sig)[None]
+    msgs = _g2_aff(h)[None]
+    xp, yp, q = pp.pack_verify_inputs(pubs, sigs, msgs)
+
+    # host-side inputs (value level)
+    g1neg = -PointG1.generator()
+    nx, ny = g1neg.to_affine()
+    px, py = pub.to_affine()
+    sx, sy = sig.to_affine()
+    hx, hy = h.to_affine()
+    xps = [nx.v, px.v]
+    yps = [ny.v, py.v]
+    qs = [(sx, sy), (hx, hy)]
+
+    lo, hi = 0, pp.N_MILLER
+    # first verify divergence at full length
+    for n_iter in (pp.N_MILLER,):
+        fw_h, Ts_h = run_host(xps, yps, qs, n_iter)
+        f_d, X_d, Y_d, Z_d = device_partial(xp, yp, q, n_iter)
+        fw_d = np.asarray(jax.jit(lambda f: jnp.stack(
+            [pp.f12_to_w(f)[k] for k in range(6)]))(f_d))
+        div = [k for k in range(6) if unpack_f2(fw_d[k]) != fw_h[k]]
+        print(f"n_iter={n_iter}: diverging w-coeffs {div}")
+        if not div:
+            print("no divergence at full length?!")
+            return
+    while hi - lo > 1:
+        mid = (lo + hi) // 2
+        fw_h, Ts_h = run_host(xps, yps, qs, mid)
+        f_d, X_d, Y_d, Z_d = device_partial(xp, yp, q, mid)
+        fw_d = np.asarray(jax.jit(lambda f: jnp.stack(
+            [pp.f12_to_w(f)[k] for k in range(6)]))(f_d))
+        div = [k for k in range(6) if unpack_f2(fw_d[k]) != fw_h[k]]
+        # also compare T states
+    # T device: X_d (np, 2, 32, 1)
+        tdiv = []
+        for i in range(2):
+            for nm, comp_d, comp_h in (("X", X_d, Ts_h[i][0]),
+                                       ("Y", Y_d, Ts_h[i][1]),
+                                       ("Z", Z_d, Ts_h[i][2])):
+                if unpack_f2(np.asarray(comp_d)[i]) != comp_h:
+                    tdiv.append((i, nm))
+        print(f"iter {mid}: f-div {div} T-div {tdiv}")
+        if div or tdiv:
+            hi = mid
+        else:
+            lo = mid
+    print(f"first divergence at iteration {hi}")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 126)

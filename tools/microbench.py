@@ -1,0 +1,117 @@
+"""Microbenchmarks for the TPU compute primitives the field arithmetic
+could be built from. Informs the roofline note (ROOFLINE.md): measures
+sustained throughput of
+
+  - int32 elementwise multiply-add on the VPU (current ops/bl.py core)
+  - f32 elementwise multiply-add on the VPU (candidate: float limbs)
+  - bf16 MXU matmul with f32 accumulation (candidate: constant-Toeplitz
+    REDC, exact for 8-bit limb operands)
+  - int8 MXU matmul with int32 accumulation (candidate alternative)
+
+Each case runs inside ONE Pallas kernel (the axon stack's XLA glue
+miscompile makes plain-XLA loops untrustworthy; Mosaic is the production
+path anyway) as a dependent fori_loop chain over live VMEM tiles.
+
+Usage: python tools/microbench.py [reps]
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from drand_tpu.utils.jit_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+N_ITERS = 512
+
+
+def _time(fn, *args, reps=5):
+    fn(*args)[0].block_until_ready() if isinstance(fn(*args), tuple) else \
+        fn(*args).block_until_ready()
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn(*args).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _pallas1(kernel, out_sd):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.pallas_call(
+        kernel, out_shape=out_sd,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 2,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM))
+
+
+def vpu_kernel(x_ref, y_ref, o_ref):
+    y = y_ref[:]
+
+    def body(i, x):
+        return x * y + y
+
+    o_ref[:] = jax.lax.fori_loop(0, N_ITERS, body, x_ref[:])
+
+
+def mxu_kernel(a_ref, b_ref, o_ref):
+    a = a_ref[:]
+
+    def body(i, acc):
+        return jnp.dot(acc.astype(a_ref.dtype), a,
+                       preferred_element_type=o_ref.dtype)
+
+    o_ref[:] = jax.lax.fori_loop(
+        0, N_ITERS, body, b_ref[:].astype(o_ref.dtype))
+
+
+def run():
+    results = {}
+    # --- VPU elementwise: (256, 128) tile, 512 dependent mul+add ---
+    shape = (256, 128)
+    n_ops = N_ITERS * shape[0] * shape[1] * 2  # mul + add
+    for dtype, name in ((jnp.int32, "vpu_int32"), (jnp.float32, "vpu_f32"),
+                        (jnp.bfloat16, "vpu_bf16")):
+        x = jnp.ones(shape, dtype)
+        y = jnp.ones(shape, dtype)
+        fn = jax.jit(_pallas1(vpu_kernel,
+                              jax.ShapeDtypeStruct(shape, dtype)))
+        dt = _time(fn, x, y)
+        results[name] = n_ops / dt / 1e9
+        print(f"{name:12s} {n_ops / dt / 1e9:10.1f} Gop/s  ({dt*1e3:.2f} ms)")
+
+    # --- MXU matmul: (128,128)@(128,128) chains ---
+    for in_dt, acc_dt, name in (
+            (jnp.bfloat16, jnp.float32, "mxu_bf16_f32"),
+            (jnp.int8, jnp.int32, "mxu_int8_i32"),
+            (jnp.float32, jnp.float32, "mxu_f32_f32")):
+        m = 128
+        a = jnp.ones((m, m), in_dt)
+        b = jnp.ones((m, m), in_dt)
+        n_ops = N_ITERS * m * m * m * 2
+        try:
+            fn = jax.jit(_pallas1(mxu_kernel,
+                                  jax.ShapeDtypeStruct((m, m), acc_dt)))
+            dt = _time(fn, a, b)
+            results[name] = n_ops / dt / 1e12
+            print(f"{name:12s} {n_ops / dt / 1e12:10.2f} Top/s  "
+                  f"({dt*1e3:.2f} ms)")
+        except Exception as e:  # noqa: BLE001 - probing lowering support
+            print(f"{name:12s} UNSUPPORTED: {type(e).__name__}: "
+                  f"{str(e)[:200]}")
+    return results
+
+
+if __name__ == "__main__":
+    print("devices:", jax.devices())
+    run()

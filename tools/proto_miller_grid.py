@@ -1,0 +1,163 @@
+"""Prototype: Miller loop as a Pallas grid over iterations (small body per
+step, scratch-carried state) vs the current single-fori_loop kernel.
+
+Hypothesis: the 63-iteration fori_loop body is too large for good Mosaic
+register allocation (measured 15M fp-mul/s vs 157M for a lean chain
+kernel); a grid step per iteration should compile to far better code.
+
+Usage: python tools/proto_miller_grid.py [B]
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from drand_tpu.utils.jit_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+from drand_tpu.ops import bl
+from drand_tpu.ops import pallas_pairing as pp
+from drand_tpu.ops.bl import NLIMBS, DTYPE, f12_conj
+
+
+def _miller_grid_kernel(flags_ref, c_ref, xp_ref, yp_ref, q_ref, o_ref,
+                        f_ref, tx_ref, ty_ref, tz_ref):
+    """One Miller iteration per grid step. flags_ref is scalar-prefetched
+    SMEM; state persists in scratch across steps."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    with bl.const_context(c_ref[:]):
+        xp, yp, q = xp_ref[:], yp_ref[:], q_ref[:]
+        npairs = q.shape[0]
+        b = q.shape[-1]
+        xq, yq = q[..., 0, :, :, :], q[..., 1, :, :, :]
+
+        @pl.when(i == 0)
+        def _init():
+            one_fp = jnp.broadcast_to(
+                bl._crow("ONE"), xq.shape[:-3] + (NLIMBS, b)).astype(DTYPE)
+            f_ref[:] = bl.f12_one((), b)
+            tx_ref[:] = xq
+            ty_ref[:] = yq
+            tz_ref[:] = jnp.stack([one_fp, jnp.zeros_like(one_fp)], axis=-3)
+
+        f = bl.f12_sqr(f_ref[:])
+        T, lines = pp._dbl_step((tx_ref[:], ty_ref[:], tz_ref[:]), xp, yp)
+        f_ref[:] = pp._sparse_mul_035(f, lines, npairs, split=True)
+        tx_ref[:], ty_ref[:], tz_ref[:] = T
+
+        @pl.when(flags_ref[i] != 0)
+        def _add():
+            Ta, lines_a = pp._add_step(
+                (tx_ref[:], ty_ref[:], tz_ref[:]), q, xp, yp)
+            f_ref[:] = pp._sparse_mul_035(f_ref[:], lines_a, npairs,
+                                          split=True)
+            tx_ref[:], ty_ref[:], tz_ref[:] = Ta
+
+        @pl.when(i == pl.num_programs(0) - 1)
+        def _fin():
+            o_ref[:] = f12_conj(f_ref[:])
+
+
+def miller_grid(xp, yp, q):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    npairs, b = q.shape[0], q.shape[-1]
+    f12_dims = (2, 3, 2, NLIMBS, b)
+    t_dims = (npairs, 2, NLIMBS, b)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(pp.N_MILLER,),
+        in_specs=[
+            pl.BlockSpec(bl.CONST_BUFFER.shape, lambda i, *_: (0, 0)),
+            pl.BlockSpec(xp.shape, lambda i, *_: (0,) * xp.ndim),
+            pl.BlockSpec(yp.shape, lambda i, *_: (0,) * yp.ndim),
+            pl.BlockSpec(q.shape, lambda i, *_: (0,) * q.ndim),
+        ],
+        out_specs=pl.BlockSpec(f12_dims, lambda i, *_: (0,) * 5),
+        scratch_shapes=[pltpu.VMEM(f12_dims, DTYPE),
+                        pltpu.VMEM(t_dims, DTYPE),
+                        pltpu.VMEM(t_dims, DTYPE),
+                        pltpu.VMEM(t_dims, DTYPE)],
+    )
+    fn = pl.pallas_call(
+        _miller_grid_kernel,
+        out_shape=jax.ShapeDtypeStruct(f12_dims, DTYPE),
+        grid_spec=grid_spec)
+    flags = jnp.asarray(pp.MILLER_FLAGS[0], dtype=jnp.int32)
+    return fn(flags, jnp.asarray(bl.CONST_BUFFER), xp, yp, q)
+
+
+def run(B=128):
+    from drand_tpu.crypto import bls
+    from drand_tpu.crypto.curves import PointG1, PointG2
+    from drand_tpu.crypto.hash_to_curve import hash_to_g2
+    from drand_tpu.ops import limb
+    from drand_tpu.ops.engine import _g1_aff, _g2_aff
+
+    sk = 0x1F3A
+    pub_aff = _g1_aff(PointG1.generator().mul(sk))
+    sigs, msgs = [], []
+    for i in range(8):
+        m = b"bench-%d" % i
+        msgs.append(_g2_aff(hash_to_g2(m)))
+        sigs.append(_g2_aff(PointG2.from_bytes(bls.sign(sk, m),
+                                               subgroup_check=False)))
+    pubs = np.broadcast_to(pub_aff, (B, 2, limb.NLIMBS))
+    sigs = np.stack([sigs[i % 8] for i in range(B)])
+    msgs = np.stack([msgs[i % 8] for i in range(B)])
+    xp, yp, q = pp.pack_verify_inputs(pubs, sigs, msgs)
+
+    grid_fn = jax.jit(miller_grid)
+    t0 = time.perf_counter()
+    out_g = np.asarray(grid_fn(xp, yp, q))
+    print(f"grid miller: compile+run {time.perf_counter()-t0:.1f}s",
+          file=sys.stderr)
+
+    # reference: existing fused kernel
+    consts = jnp.asarray(bl.CONST_BUFFER)
+    f12_shape = jax.ShapeDtypeStruct((2, 3, 2, NLIMBS, B), DTYPE)
+    f12_dims = (2, 3, 2, NLIMBS, B)
+    t_dims = (2, 2, NLIMBS, B)
+    old_fn = jax.jit(lambda c, fl, x, y, qq: pp._pallas(
+        pp._miller_kernel, f12_shape, "vsvvv",
+        scratch_shapes=(f12_dims, t_dims, t_dims, t_dims))(c, fl, x, y, qq))
+    flags = jnp.asarray(pp.MILLER_FLAGS)
+    out_o = np.asarray(old_fn(consts, flags, xp, yp, q))
+    same = (out_g == out_o).all()
+    print(f"outputs identical: {same}")
+    if not same:
+        print("MISMATCH", np.argwhere(out_g != out_o)[:5])
+
+    K = 48
+    for name, fn, args in (("old", old_fn, (consts, flags, xp, yp, q)),
+                           ("grid", grid_fn, (xp, yp, q))):
+        o = None
+        t0 = time.perf_counter()
+        for _ in range(K):
+            if o is not None:  # chain a dependency to force ordering
+                dep = (o[0, 0, 0, :1, :1] * 0)
+                a0 = args[-3] + dep[None] if name == "grid" else args[0]
+                o = fn(*((a0,) + args[1:])) if name == "grid" else \
+                    fn(args[0], args[1], args[2] + dep[None], args[3],
+                       args[4])
+            else:
+                o = fn(*args)
+        np.asarray(o)
+        dt = (time.perf_counter() - t0) / K
+        print(f"{name}: {dt*1e3:.2f} ms/call @ B={B}")
+
+
+if __name__ == "__main__":
+    run(int(sys.argv[1]) if len(sys.argv) > 1 else 128)

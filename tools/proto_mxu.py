@@ -1,0 +1,183 @@
+"""Prototype: Montgomery REDC as int8 MXU matmuls inside a Pallas kernel.
+
+Validates exactness vs the host oracle and times a chain of stacked
+f2_mul-style multiplies (the pairing's inner op) with the current
+all-VPU mont_mul vs the MXU-REDC variant. Decides the bl.py redesign.
+
+Usage: python tools/proto_mxu.py [B] [iters]
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from drand_tpu.utils.jit_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+from drand_tpu.crypto.fields import P, Fp
+from drand_tpu.ops import bl
+from drand_tpu.ops import limb as _x
+
+NLIMBS = bl.NLIMBS
+MASK = bl.MASK
+BITS = bl.BITS
+
+# --- constant Toeplitz matrices, int8 6/6-bit split -----------------------
+
+def _toeplitz(limbs, out_len):
+    t = np.zeros((out_len, NLIMBS), np.int64)
+    for k in range(out_len):
+        for i in range(NLIMBS):
+            j = k - i
+            if 0 <= j < NLIMBS:
+                t[k, i] = limbs[j]
+    return t
+
+
+def _split_matrix(limbs, out_len):
+    """(4*out_len, 2*NLIMBS) int8 block matrix for inputs [x_lo; x_hi]
+    (7-bit split) against entries e = e_lo + 64*e_hi (6-bit split).
+    Output row blocks: S_ll, S_hl, S_lh, S_hh with weights 1,64,128,8192."""
+    t = _toeplitz(limbs, out_len)
+    e_lo, e_hi = t & 63, t >> 6
+    z = np.zeros_like(t)
+    g = np.block([[e_lo, z], [e_hi, z], [z, e_lo], [z, e_hi]])
+    assert g.max() <= 127
+    return g.astype(np.int8)
+
+
+G_NPRIME = _split_matrix(np.asarray(_x._NPRIME_LIMBS, np.int64), NLIMBS)
+G_P = _split_matrix(np.asarray(_x.P_LIMBS, np.int64), 2 * NLIMBS)
+
+
+def _redc_matmul(g, x):
+    """x: (..., 32, B) limbs <= 2^13 -> combined conv with the constant
+    matrix, exact. Leading dims handled by a static unrolled loop."""
+    r4 = g.shape[0]
+    r = r4 // 4
+    x_lo = (x & 127).astype(jnp.int8)
+    x_hi = (x >> 7).astype(jnp.int8)
+    xs = jnp.concatenate([x_lo, x_hi], axis=-2)  # (..., 64, B)
+    lead = x.shape[:-2]
+    if lead:
+        flat = xs.reshape((-1,) + xs.shape[-2:])
+        outs = [jax.lax.dot_general(
+            g, flat[i], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+            for i in range(flat.shape[0])]
+        s = jnp.stack(outs, axis=0).reshape(lead + (r4, x.shape[-1]))
+    else:
+        s = jax.lax.dot_general(g, xs, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.int32)
+    ll = s[..., 0 * r:1 * r, :]
+    hl = s[..., 1 * r:2 * r, :]
+    lh = s[..., 2 * r:3 * r, :]
+    hh = s[..., 3 * r:4 * r, :]
+    return ll + (hl << 6) + (lh << 7) + (hh << 13)
+
+
+_G2 = None
+_G3 = None
+
+
+def mont_mul_mxu(a, b):
+    """bl.mont_mul with the two constant-operand convolutions on the MXU."""
+    t = bl._conv(a, b, 2 * NLIMBS)
+    t = bl._fold(t, rounds=3, grow=True)
+    m = _redc_matmul(_G2, t[..., :NLIMBS, :])
+    m = bl._fold_drop(m, rounds=3)
+    u = _redc_matmul(_G3, m)
+    z = jnp.zeros_like(u[..., :1, :])
+    u = jnp.concatenate([u, z], axis=-2) + t
+    u = bl._fold(u, rounds=3, grow=True)
+    k = jnp.any(u[..., :NLIMBS, :] != 0, axis=-2).astype(bl.DTYPE)
+    hi = u[..., NLIMBS:, :]
+    r_ = jnp.concatenate([hi[..., :1, :] + k[..., None, :], hi[..., 1:, :]],
+                         axis=-2)
+    return bl._wrap(bl._fold(r_, rounds=1, grow=False), passes=2)
+
+
+# --- kernels ---------------------------------------------------------------
+
+def _chain_kernel(n_iters, use_mxu, c_ref, g2_ref, g3_ref, a_ref, b_ref,
+                  o_ref):
+    global _G2, _G3
+    with bl.const_context(c_ref[:]):
+        _G2, _G3 = g2_ref[:], g3_ref[:]
+        mm = mont_mul_mxu if use_mxu else bl.mont_mul
+        a, b = a_ref[:], b_ref[:]
+
+        def body(i, a):
+            return mm(a, b)
+
+        o_ref[:] = jax.lax.fori_loop(0, n_iters, body, a)
+
+
+def run(batch=128, iters=64):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    import random
+    rnd = random.Random(7)
+    vals_a = [rnd.randrange(P) for _ in range(batch)]
+    vals_b = [rnd.randrange(P) for _ in range(batch)]
+    vals_a[0], vals_b[0] = P - 1, P - 1
+    # stacked leading dim 3, mirroring f2_mul's Karatsuba stack
+    a = jnp.broadcast_to(bl.pack_fp(vals_a), (3, NLIMBS, batch))
+    b = jnp.broadcast_to(bl.pack_fp(vals_b), (3, NLIMBS, batch))
+
+    lanebuf = bl.lane_buffer(batch)
+    out_sd = jax.ShapeDtypeStruct((3, NLIMBS, batch), bl.DTYPE)
+
+    results = {}
+    for use_mxu in (False, True):
+        kern = functools.partial(_chain_kernel, iters, use_mxu)
+        fn = jax.jit(pl.pallas_call(
+            kern, out_shape=out_sd,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 5,
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM)))
+        args = (jnp.asarray(lanebuf), jnp.asarray(G_NPRIME),
+                jnp.asarray(G_P), a, b)
+        t0 = time.perf_counter()
+        out = np.asarray(fn(*args))
+        print(f"mxu={use_mxu}: compile+run {time.perf_counter()-t0:.1f}s",
+              file=sys.stderr)
+        # correctness vs host: a * b^iters * R^-iters... just iterate host
+        R_INV = pow(1 << 384, -1, P)
+        host = list(vals_a)
+        for _ in range(iters):
+            host = [(x * y * R_INV) % P for x, y in zip(host, vals_b)]
+        got = bl.unpack_fp(out[0])
+        exp = [(h * 1) % P for h in host]
+        ok = got == exp
+        print(f"mxu={use_mxu}: correct={ok}")
+        if not ok:
+            badidx = [i for i, (g, e) in enumerate(zip(got, exp)) if g != e]
+            print(f"  bad lanes: {badidx[:8]} ...", file=sys.stderr)
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            np.asarray(fn(*args))
+            best = min(best, time.perf_counter() - t0)
+        n_mm = iters * 3
+        print(f"mxu={use_mxu}: {best*1e3:.2f} ms for {n_mm} stacked "
+              f"mont_muls @ B={batch} -> "
+              f"{n_mm * batch / best / 1e6:.2f} M fp-mul/s")
+        results[use_mxu] = best
+    if False in results and True in results:
+        print(f"speedup: {results[False] / results[True]:.2f}x")
+
+
+if __name__ == "__main__":
+    b = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    it = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    run(b, it)
