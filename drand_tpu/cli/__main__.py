@@ -248,6 +248,41 @@ def cmd_show(args) -> None:
 
 
 def cmd_get(args) -> None:
+    if args.what == "private":
+        # ECIES private randomness round-trip (reference cli.go getPrivateCmd;
+        # core/drand_public.go:126): fetch + self-verify the node identity,
+        # then run the ephemeral-key exchange
+        if not args.connect:
+            raise SystemExit("get private requires --connect <node-addr>")
+
+        async def run_private():
+            from ..client.private import private_rand
+            from ..net.grpc_transport import GrpcClient
+
+            import dataclasses
+
+            client = GrpcClient(own_addr="client")
+            try:
+                ident = await client.get_identity(args.connect)
+                if not ident.valid_signature():
+                    raise SystemExit(
+                        "node identity failed self-signature check")
+                # dial the address the OPERATOR gave (reachable), not the
+                # node's self-reported one (may be internal/NATed); the
+                # identity's key still targets the ECIES encryption
+                dial = dataclasses.replace(ident, addr=args.connect)
+                out = await private_rand(client, dial)
+                print(json.dumps({"node": ident.addr,
+                                  "randomness": out.hex()}))
+            finally:
+                await client.close()
+
+        asyncio.run(run_private())
+        return
+
+    if not args.url:
+        raise SystemExit(f"get {args.what} requires --url")
+
     async def run():
         from ..client.http import HTTPClient
 
@@ -294,6 +329,34 @@ def cmd_util(args) -> None:
         store.close()
         print(json.dumps({"deleted": removed, "from_round": args.round,
                           "was_at": last}))
+        return
+    if args.what == "reset":
+        # reference cli.go resetCmd: drop the distributed state (share,
+        # group, chain) but KEEP the longterm keypair — the node can then
+        # join a fresh DKG under the same identity. Daemon must be stopped.
+        if not args.force:
+            raise SystemExit("util reset deletes the share, group file and "
+                             "beacon database (keypair kept) — re-run with "
+                             "--force to confirm")
+        folder = _folder(args)
+        removed = []
+        import shutil
+
+        from ..key import store as key_store
+
+        for rel in (f"{key_store.GROUP_FOLDER}/{key_store.SHARE_FILE}",
+                    f"{key_store.GROUP_FOLDER}/{key_store.GROUP_FILE}",
+                    f"{key_store.GROUP_FOLDER}/{key_store.DIST_KEY_FILE}"):
+            p = os.path.join(folder, rel)
+            if os.path.isfile(p):
+                os.unlink(p)
+                removed.append(rel)
+        dbdir = os.path.join(folder, "db")
+        if os.path.isdir(dbdir):
+            shutil.rmtree(dbdir)
+            removed.append("db/")
+        print(json.dumps({"reset": True, "removed": removed,
+                          "folder": folder}))
         return
     if args.what == "self-sign":
         from ..key.store import FileStore
@@ -568,18 +631,21 @@ def main(argv=None) -> None:
     show.set_defaults(fn=cmd_show)
 
     get = sub.add_parser("get")
-    get.add_argument("what", choices=["public", "chain-info"])
-    get.add_argument("--url", required=True)
+    get.add_argument("what", choices=["public", "chain-info", "private"])
+    get.add_argument("--url", help="HTTP base URL (public/chain-info)")
+    get.add_argument("--connect", help="node gRPC address (private)")
     get.add_argument("--round", type=int, default=0)
     get.set_defaults(fn=cmd_get)
 
     u = sub.add_parser("util")
     u.add_argument("what", choices=["ping", "check", "del-beacon",
-                                    "self-sign"])
+                                    "self-sign", "reset"])
     u.add_argument("--control", type=int, default=8888)
     u.add_argument("--address")
     u.add_argument("--folder")
     u.add_argument("--round", type=int, default=None)
+    u.add_argument("--force", action="store_true",
+                   help="confirm destructive util commands (reset)")
     u.set_defaults(fn=cmd_util)
 
     r = sub.add_parser("relay")

@@ -17,6 +17,7 @@ class WatchAggregator(Client):
         self._src = source
         self._subs: list[asyncio.Queue] = []
         self._pump: asyncio.Task | None = None
+        self._watch_info = None  # chain Info for the latency gauge
 
     async def get(self, round_no: int = 0) -> Result:
         return await self._src.get(round_no)
@@ -47,7 +48,13 @@ class WatchAggregator(Client):
         every subscriber forever)."""
         while True:
             try:
+                if self._watch_info is None:
+                    try:
+                        self._watch_info = await self._src.info()
+                    except Exception:  # noqa: BLE001 — latency metric only
+                        pass
                 async for r in self._src.watch():
+                    self._observe_latency(r)
                     for q in list(self._subs):
                         try:
                             q.put_nowait(r)
@@ -58,6 +65,25 @@ class WatchAggregator(Client):
             except Exception:  # noqa: BLE001 — retry upstream
                 pass
             await asyncio.sleep(1.0)
+
+    def _observe_latency(self, r) -> None:
+        """client_watch_latency: ms between receipt and the round's
+        expected time (client/http/metric.go:14 observe loop)."""
+        try:
+            import time as _time
+
+            from ..chain import time_math
+            from .. import metrics
+
+            info = self._watch_info
+            if info is None:
+                return
+            expected = time_math.time_of_round(info.period,
+                                               info.genesis_time, r.round)
+            metrics.CLIENT_WATCH_LATENCY.set(
+                (_time.time() - expected) * 1000.0)
+        except Exception:  # noqa: BLE001 — metrics never break the pump
+            pass
 
     async def close(self) -> None:
         if self._pump is not None:

@@ -47,18 +47,30 @@ class HTTPClient(Client):
         return self._session
 
     async def _get_json(self, path: str) -> dict:
+        from .. import metrics
+
         sess = await self._sess()
+        metrics.CLIENT_IN_FLIGHT.labels(url=self._base).inc()
         try:
-            async with sess.get(self._base + path) as resp:
-                body = await resp.json()
-                if resp.status != 200:
-                    raise ClientError(
-                        f"GET {path}: {resp.status} {body.get('error', '')}")
-                return body
+            with metrics.CLIENT_REQUEST_DURATION.labels(
+                    url=self._base).time():
+                async with sess.get(self._base + path) as resp:
+                    body = await resp.json()
+                    metrics.CLIENT_REQUESTS.labels(
+                        url=self._base, code=str(resp.status)).inc()
+                    if resp.status != 200:
+                        raise ClientError(
+                            f"GET {path}: {resp.status} "
+                            f"{body.get('error', '')}")
+                    return body
         except (aiohttp.ClientError, ValueError) as e:
             # ValueError covers json.JSONDecodeError from malformed bodies:
             # a ClientError keeps the optimizing client's failover working
+            metrics.CLIENT_REQUESTS.labels(url=self._base,
+                                           code="err").inc()
             raise ClientError(f"GET {path}: {e!r}") from e
+        finally:
+            metrics.CLIENT_IN_FLIGHT.labels(url=self._base).dec()
 
     # ------------------------------------------------------------- Client
     async def get(self, round_no: int = 0) -> Result:

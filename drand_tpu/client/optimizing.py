@@ -87,12 +87,21 @@ class OptimizingClient(Client):
         asyncio.ensure_future(self._rank())
 
     async def _rank(self) -> None:
+        from .. import metrics
+
         async def probe(src: Client) -> tuple[float, Client]:
+            # the speed test doubles as the client heartbeat
+            # (client/http/metric.go:14 startObserve)
+            url = getattr(src, "_base", None) or type(src).__name__
             t0 = time.monotonic()
             try:
                 await asyncio.wait_for(src.get(0), self._timeout)
-                return (time.monotonic() - t0, src)
+                dt = time.monotonic() - t0
+                metrics.CLIENT_HEARTBEAT_SUCCESS.labels(url=url).inc()
+                metrics.CLIENT_HEARTBEAT_LATENCY.labels(url=url).set(dt)
+                return (dt, src)
             except (ClientError, asyncio.TimeoutError, OSError):
+                metrics.CLIENT_HEARTBEAT_FAILURE.labels(url=url).inc()
                 return (float("inf"), src)
 
         timings = await asyncio.gather(*(probe(s) for s in list(self._sources)))

@@ -107,8 +107,12 @@ class PublicServer:
 
         path = request.match_info.route.resource
         path = path.canonical if path else request.path
-        with metrics.HTTP_LATENCY.labels(path=path).time():
-            resp = await handler(request)
+        metrics.HTTP_IN_FLIGHT.inc()
+        try:
+            with metrics.HTTP_LATENCY.labels(path=path).time():
+                resp = await handler(request)
+        finally:
+            metrics.HTTP_IN_FLIGHT.dec()
         metrics.HTTP_REQUESTS.labels(path=path, code=resp.status).inc()
         return resp
 

@@ -1,10 +1,35 @@
-"""Prometheus metrics.
+"""Prometheus metrics — the reference catalogue, four registries.
 
-Reference: metrics/metrics.go:17-146 — beacon discrepancy latency, last
-round gauges, dial failures, HTTP counters — and the store decorator that
-feeds them (chain/beacon/store.go:57 discrepancyStore). Exposed on the
-public REST server's /metrics route (the reference serves a dedicated
-metrics port; one port fewer here, same scrape surface).
+Reference: metrics/metrics.go:17-146 defines four registries
+(PrivateMetrics :17, HTTPMetrics :20, GroupMetrics :22, ClientMetrics
+:24) and the catalogue below; client/http/metric.go:14 adds the client
+heartbeat set. The store decorator feeding the beacon gauges is
+chain/beacon/store.go:57 (discrepancyStore → our DiscrepancyStore).
+
+Catalogue parity (reference name → here):
+  api_call_counter               → api_call_counter           [private]
+  dial_failures                  → outgoing_connection_failures [group]
+  group_connections              → group_connections          [group]
+  beacon_discrepancy_latency     → beacon_discrepancy_latency_ms [group]
+  last_beacon_round              → last_beacon_round          [group]
+  http_call_counter              → http_api_requests          [http]
+  http_response_duration         → http_api_latency_seconds   [http]
+  http_in_flight                 → http_in_flight             [http]
+  client_watch_latency           → client_watch_latency       [client]
+  client_http_heartbeat_success  → client_http_heartbeat_success [client]
+  client_http_heartbeat_failure  → client_http_heartbeat_failure [client]
+  client_http_heartbeat_latency  → client_http_heartbeat_latency [client]
+  client_in_flight               → client_in_flight           [client]
+  client_api_requests_total      → client_api_requests_total  [client]
+  client_request_duration_seconds→ client_request_duration_seconds [client]
+  (client_dns/tls_duration_seconds are Go httptrace hooks with no
+   asyncio equivalent — intentionally absent)
+Additions beyond the reference (the TPU engine):
+  engine_device_batches, engine_device_fallbacks, dkg_bundles_received
+
+Everything is exposed on /metrics (render() gathers all four registries
+— the reference's handler chains its gatherers the same way,
+metrics.go:229) and relayed per peer via /peer/{addr}/metrics.
 """
 
 from __future__ import annotations
@@ -17,34 +42,73 @@ from prometheus_client import (
     generate_latest,
 )
 
-REGISTRY = CollectorRegistry()
+# Four registries (metrics.go:17-24). REGISTRY keeps its old name as the
+# private/default one for back-compat with existing callers.
+REGISTRY = CollectorRegistry()          # PrivateMetrics
+HTTP_REGISTRY = CollectorRegistry()     # HTTPMetrics
+GROUP_REGISTRY = CollectorRegistry()    # GroupMetrics
+CLIENT_REGISTRY = CollectorRegistry()   # ClientMetrics
 
-# chain/beacon metrics (metrics.go:41-50)
+# ---- private (node-to-node API) -------------------------------------------
+API_CALLS = Counter(
+    "api_call_counter", "Private gRPC API calls", ["method"],
+    registry=REGISTRY)
+
+# ---- group (chain + mesh health) ------------------------------------------
 BEACON_DISCREPANCY_LATENCY = Gauge(
     "beacon_discrepancy_latency_ms",
     "Milliseconds between the expected round time and the beacon being stored",
-    registry=REGISTRY)
+    registry=GROUP_REGISTRY)
 LAST_BEACON_ROUND = Gauge(
     "last_beacon_round", "Last aggregated and stored beacon round",
-    registry=REGISTRY)
-
-# network health (metrics.go:60-75)
+    registry=GROUP_REGISTRY)
 DIAL_FAILURES = Counter(
     "outgoing_connection_failures",
-    "Failed outbound node-to-node calls", ["peer"], registry=REGISTRY)
+    "Failed outbound node-to-node calls", ["peer"],
+    registry=GROUP_REGISTRY)
+GROUP_CONNECTIONS = Gauge(
+    "group_connections", "Open outbound connections to group members",
+    registry=GROUP_REGISTRY)
 DKG_BUNDLES = Counter(
     "dkg_bundles_received", "DKG bundles accepted by the broadcast board",
-    ["kind"], registry=REGISTRY)
+    ["kind"], registry=GROUP_REGISTRY)
 
-# public API (metrics.go:90-120)
+# ---- http (public REST server) --------------------------------------------
 HTTP_REQUESTS = Counter(
     "http_api_requests", "Public REST API calls", ["path", "code"],
-    registry=REGISTRY)
+    registry=HTTP_REGISTRY)
 HTTP_LATENCY = Histogram(
     "http_api_latency_seconds", "Public REST API latency", ["path"],
-    registry=REGISTRY)
+    registry=HTTP_REGISTRY)
+HTTP_IN_FLIGHT = Gauge(
+    "http_in_flight", "In-flight public REST requests",
+    registry=HTTP_REGISTRY)
 
-# crypto engine
+# ---- client (the consuming side: watches, heartbeats) ---------------------
+CLIENT_WATCH_LATENCY = Gauge(
+    "client_watch_latency",
+    "Duration between time round received and time round expected (ms)",
+    registry=CLIENT_REGISTRY)
+CLIENT_HEARTBEAT_SUCCESS = Counter(
+    "client_http_heartbeat_success", "Successful client heartbeats",
+    ["url"], registry=CLIENT_REGISTRY)
+CLIENT_HEARTBEAT_FAILURE = Counter(
+    "client_http_heartbeat_failure", "Failed client heartbeats",
+    ["url"], registry=CLIENT_REGISTRY)
+CLIENT_HEARTBEAT_LATENCY = Gauge(
+    "client_http_heartbeat_latency", "Last client heartbeat latency (s)",
+    ["url"], registry=CLIENT_REGISTRY)
+CLIENT_IN_FLIGHT = Gauge(
+    "client_in_flight", "In-flight client requests per url",
+    ["url"], registry=CLIENT_REGISTRY)
+CLIENT_REQUESTS = Counter(
+    "client_api_requests_total", "Client requests by url and outcome",
+    ["url", "code"], registry=CLIENT_REGISTRY)
+CLIENT_REQUEST_DURATION = Histogram(
+    "client_request_duration_seconds", "Client request latency",
+    ["url"], registry=CLIENT_REGISTRY)
+
+# ---- engine (no reference counterpart: the TPU compute path) --------------
 ENGINE_BATCHES = Counter(
     "engine_device_batches", "Batched device crypto calls", ["op"],
     registry=REGISTRY)
@@ -54,5 +118,8 @@ ENGINE_FALLBACKS = Counter(
 
 
 def render() -> bytes:
-    """The /metrics payload."""
-    return generate_latest(REGISTRY)
+    """The /metrics payload: all four registries gathered (the
+    reference's chained-gatherer handler, metrics.go:229-266)."""
+    return b"".join(generate_latest(r) for r in
+                    (REGISTRY, GROUP_REGISTRY, HTTP_REGISTRY,
+                     CLIENT_REGISTRY))

@@ -25,6 +25,7 @@ from .packets import PartialBeaconPacket, SyncRequest
 from .transport import ProtocolClient, ProtocolService, TransportError
 
 SERVICE = "drand.Protocol"
+PUBLIC_SERVICE = "drand.Public"  # protobuf interop surface (api.proto)
 _UNARY = ("GetIdentity", "SignalDKGParticipant", "PushDKGInfo",
           "BroadcastDKG", "PartialBeacon", "ChainInfo", "PrivateRand",
           "Metrics", "PublicRand")
@@ -60,6 +61,21 @@ class GrpcGateway:
             self._public_rand_stream)
         server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+        # drand.Public: the reference's protobuf wire (net/protowire.py) —
+        # ecosystem drand clients fetch/stream from this service untouched
+        pub = {
+            "PublicRand": grpc.unary_unary_rpc_method_handler(
+                self._pb_public_rand),
+            "PublicRandStream": grpc.unary_stream_rpc_method_handler(
+                self._pb_public_rand_stream),
+            "PrivateRand": grpc.unary_unary_rpc_method_handler(
+                self._pb_private_rand),
+            "ChainInfo": grpc.unary_unary_rpc_method_handler(
+                self._pb_chain_info),
+            "Home": grpc.unary_unary_rpc_method_handler(self._pb_home),
+        }
+        server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(PUBLIC_SERVICE, pub),))
         if self._tls is not None:
             from . import tls as tls_mod
 
@@ -93,6 +109,9 @@ class GrpcGateway:
         }[name]
 
         async def handler(request: bytes, context) -> bytes:
+            from .. import metrics
+
+            metrics.API_CALLS.labels(method=name).inc()
             try:
                 msg, from_addr = wire.decode(request)
                 return await method(msg, from_addr)
@@ -152,16 +171,98 @@ class GrpcGateway:
             await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
 
     async def _sync_chain(self, request: bytes, context):
+        """Dual-codec: the native JSON envelope OR the reference protobuf
+        SyncRequest (protocol.proto:84-92) — an ecosystem drand node can
+        sync from us on the standard /drand.Protocol/SyncChain method.
+        The response codec follows the request codec."""
+        proto = False
         try:
             msg, from_addr = wire.decode(request)
-        except wire.WireError as e:
-            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-            return
+        except wire.WireError:
+            from . import protowire as pw
+
+            try:
+                req = pw.decode(pw.SYNC_REQUEST, request)
+            except pw.WireError as e:
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                return
+            proto = True
+            msg = SyncRequest(from_round=req["from_round"])
+            from_addr = context.peer()
         try:
             async for b in self._svc.sync_chain(from_addr, msg):
-                yield wire.encode(b)
+                if proto:
+                    from . import protowire as pw
+
+                    yield pw.encode(pw.BEACON_PACKET, {
+                        "previous_sig": b.previous_sig, "round": b.round,
+                        "signature": b.signature})
+                else:
+                    yield wire.encode(b)
         except TransportError as e:
             await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+
+    # --------------------------------------------- drand.Public (protobuf)
+    def _pb_beacon(self, b: Beacon) -> bytes:
+        from . import protowire as pw
+
+        return pw.encode(pw.PUBLIC_RAND_RESPONSE, {
+            "round": b.round, "signature": b.signature,
+            "previous_signature": b.previous_sig,
+            "randomness": b.randomness(),
+            "signature_v2": b.signature_v2})
+
+    async def _pb_public_rand(self, request: bytes, context) -> bytes:
+        from . import protowire as pw
+
+        try:
+            req = pw.decode(pw.PUBLIC_RAND_REQUEST, request)
+            b = await self._svc.public_rand(context.peer(), req["round"])
+            return self._pb_beacon(b)
+        except pw.WireError as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except (TransportError, ValueError) as e:
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+
+    async def _pb_public_rand_stream(self, request: bytes, context):
+        try:
+            async for b in self._svc.public_rand_stream(context.peer()):
+                yield self._pb_beacon(b)
+        except TransportError as e:
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+
+    async def _pb_private_rand(self, request: bytes, context) -> bytes:
+        from . import protowire as pw
+
+        try:
+            req = pw.decode(pw.PRIVATE_RAND_REQUEST, request)
+            out = await self._svc.private_rand(context.peer(),
+                                               req["request"])
+            return pw.encode(pw.PRIVATE_RAND_RESPONSE, {"response": out})
+        except pw.WireError as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except (TransportError, ValueError) as e:
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+
+    async def _pb_chain_info(self, request: bytes, context) -> bytes:
+        from . import protowire as pw
+
+        try:
+            info = await self._svc.chain_info(context.peer())
+            return pw.encode(pw.CHAIN_INFO_PACKET, {
+                "public_key": info.public_key.to_bytes(),
+                "period": info.period,
+                "genesis_time": info.genesis_time,
+                "hash": info.hash(),
+                "group_hash": info.group_hash})
+        except (TransportError, ValueError) as e:
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+
+    async def _pb_home(self, request: bytes, context) -> bytes:
+        from . import protowire as pw
+
+        return pw.encode(pw.HOME_RESPONSE,
+                         {"status": "drand-tpu up and running"})
 
 
 class GrpcClient(ProtocolClient):
@@ -195,9 +296,17 @@ class GrpcClient(ProtocolClient):
             else:
                 ch = grpc.aio.insecure_channel(target)
             self._channels[key] = ch
+            from .. import metrics
+
+            # inc/dec (not set): several clients can live in one process
+            metrics.GROUP_CONNECTIONS.inc()
         return ch, target
 
     async def close(self) -> None:
+        if self._channels:
+            from .. import metrics
+
+            metrics.GROUP_CONNECTIONS.dec(len(self._channels))
         for ch in self._channels.values():
             await ch.close()
         self._channels.clear()

@@ -1,0 +1,176 @@
+"""proto3 wire codecs for the reference drand gRPC surface.
+
+The rest of the transport speaks this framework's own deterministic JSON
+envelope (net/wire.py); THIS module implements the reference's protobuf
+byte layouts so ecosystem drand clients can fetch, stream and sync from
+a drand-tpu node over the standard service/method names. Field numbers
+and types are transcribed from the reference wire spec (the protocol
+contract, not code):
+
+- PublicRandRequest/Response, PrivateRand*, ChainInfoPacket, Home*:
+  /root/reference/protobuf/drand/api.proto:36-80,
+  /root/reference/protobuf/drand/common.proto:44-60
+- SyncRequest / BeaconPacket:
+  /root/reference/protobuf/drand/protocol.proto:84-92
+
+Hand-rolled minimal proto3 (varint + length-delimited only — every field
+in this surface is one of the two): no generated code, no protobuf
+runtime dependency. proto3 semantics honored: default-valued fields are
+omitted on encode, unknown fields are skipped on decode, last value wins
+for repeated scalar occurrences.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "encode", "decode", "WireError",
+    "PUBLIC_RAND_REQUEST", "PUBLIC_RAND_RESPONSE",
+    "PRIVATE_RAND_REQUEST", "PRIVATE_RAND_RESPONSE",
+    "CHAIN_INFO_REQUEST", "CHAIN_INFO_PACKET",
+    "SYNC_REQUEST", "BEACON_PACKET", "HOME_REQUEST", "HOME_RESPONSE",
+]
+
+
+class WireError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# varint + tag primitives
+# ---------------------------------------------------------------------------
+
+def _put_varint(out: bytearray, v: int) -> None:
+    if v < 0:  # proto3 int64: negative values use 10-byte two's complement
+        v += 1 << 64
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _get_varint(data: bytes, i: int) -> tuple[int, int]:
+    shift = 0
+    val = 0
+    while True:
+        if i >= len(data):
+            raise WireError("truncated varint")
+        b = data[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            # standard protobuf masks varints to 64 bits (a 10-byte
+            # encoding can carry up to ~2^70 otherwise)
+            return val & ((1 << 64) - 1), i
+        shift += 7
+        if shift > 63:
+            raise WireError("varint overflow")
+
+
+_VARINT, _LEN = 0, 2
+
+
+# ---------------------------------------------------------------------------
+# generic message codec: spec = {field_number: (name, kind)}
+# kinds: "u64" | "i64" (both plain varint on the wire), "bytes", "str"
+# ---------------------------------------------------------------------------
+
+def encode(spec: dict, values: dict) -> bytes:
+    out = bytearray()
+    for num in sorted(spec):
+        name, kind = spec[num]
+        v = values.get(name)
+        if kind in ("u64", "i64"):
+            v = int(v or 0)
+            if v == 0:
+                continue
+            _put_varint(out, (num << 3) | _VARINT)
+            _put_varint(out, v)
+        else:
+            if kind == "str":
+                v = (v or "").encode()
+            v = bytes(v or b"")
+            if not v:
+                continue
+            _put_varint(out, (num << 3) | _LEN)
+            _put_varint(out, len(v))
+            out += v
+    return bytes(out)
+
+
+def decode(spec: dict, data: bytes) -> dict:
+    out = {name: ("" if kind == "str" else (0 if kind in ("u64", "i64")
+                                            else b""))
+           for name, kind in spec.values()}
+    i = 0
+    while i < len(data):
+        tag, i = _get_varint(data, i)
+        num, wt = tag >> 3, tag & 7
+        if wt == _VARINT:
+            v, i = _get_varint(data, i)
+        elif wt == _LEN:
+            ln, i = _get_varint(data, i)
+            if i + ln > len(data):
+                raise WireError("truncated length-delimited field")
+            v = data[i:i + ln]
+            i += ln
+        elif wt == 1:  # 64-bit
+            if i + 8 > len(data):
+                raise WireError("truncated fixed64 field")
+            v, i = data[i:i + 8], i + 8
+        elif wt == 5:  # 32-bit
+            if i + 4 > len(data):
+                raise WireError("truncated fixed32 field")
+            v, i = data[i:i + 4], i + 4
+        else:
+            raise WireError(f"unsupported wire type {wt}")
+        field = spec.get(num)
+        if field is None:
+            continue  # unknown field: skip (proto3 forward compat)
+        name, kind = field
+        if kind in ("u64", "i64"):
+            if not isinstance(v, int):
+                raise WireError(f"field {name}: wrong wire type")
+            if kind == "i64" and v >= 1 << 63:
+                v -= 1 << 64
+            out[name] = v
+        else:
+            if isinstance(v, int):
+                raise WireError(f"field {name}: wrong wire type")
+            out[name] = v.decode() if kind == "str" else bytes(v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# message specs (field numbers from the reference .proto files)
+# ---------------------------------------------------------------------------
+
+PUBLIC_RAND_REQUEST = {1: ("round", "u64")}
+PUBLIC_RAND_RESPONSE = {
+    1: ("round", "u64"),
+    2: ("signature", "bytes"),
+    3: ("previous_signature", "bytes"),
+    4: ("randomness", "bytes"),
+    5: ("signature_v2", "bytes"),
+}
+PRIVATE_RAND_REQUEST = {1: ("request", "bytes")}
+PRIVATE_RAND_RESPONSE = {1: ("response", "bytes")}
+CHAIN_INFO_REQUEST: dict = {}
+CHAIN_INFO_PACKET = {
+    1: ("public_key", "bytes"),
+    2: ("period", "u64"),        # uint32 on the wire: same varint encoding
+    3: ("genesis_time", "i64"),
+    4: ("hash", "bytes"),
+    5: ("group_hash", "bytes"),  # `groupHash` in the .proto
+}
+SYNC_REQUEST = {1: ("from_round", "u64")}
+BEACON_PACKET = {
+    1: ("previous_sig", "bytes"),
+    2: ("round", "u64"),
+    3: ("signature", "bytes"),
+}
+HOME_REQUEST: dict = {}
+HOME_RESPONSE = {1: ("status", "str")}

@@ -120,9 +120,25 @@ class BatchedEngine:
     PIPPENGER_MIN_T = 16
 
     def __init__(self, buckets=DEFAULT_BUCKETS,
-                 wire_prep: bool | None = None):
+                 wire_prep: bool | None = None, mesh=None):
+        """``mesh``: an optional 1-axis ``jax.sharding.Mesh``; verify
+        batches whose bucket divides by the mesh size are sharded over
+        the batch axis (data parallel over rounds — SURVEY §5: the
+        chain-catchup verifier sharded across chips with pjit). The same
+        pattern the driver's dryrun_multichip validates."""
         self.buckets = tuple(sorted(buckets))
+        self.mesh = mesh
         self._verify = jax.jit(pairing.verify_prepared)
+        self._verify_sharded = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            axis = mesh.axis_names[0]
+            shard = NamedSharding(mesh, P(axis))
+            self._mesh_size = mesh.devices.size
+            self._verify_sharded = jax.jit(
+                pairing.verify_prepared,
+                in_shardings=(shard, shard, shard), out_shardings=shard)
         self._msm_g2 = jax.jit(
             lambda pts, bits: curve.pt_to_affine(
                 curve.F2, curve.msm(curve.F2, pts, bits)))
@@ -292,10 +308,20 @@ class BatchedEngine:
             sigs[i] = _g2_xy(g2_xy[2 * j])
             msgs[i] = _g2_xy(g2_xy[2 * j + 1])
             valid[i] = True
+        sharded = (self.mesh is not None and b % self._mesh_size == 0
+                   and b >= self._mesh_size)
         if _pallas_ok(b):
             from . import pallas_pairing
 
-            ok = pallas_pairing.verify_prepared_pl(pubs, sigs, msgs)
+            if sharded and (b // self._mesh_size) % \
+                    pallas_pairing.GRID_BLOCK == 0:
+                ok = pallas_pairing.verify_prepared_pl_sharded(
+                    pubs, sigs, msgs, self.mesh)
+            else:
+                ok = pallas_pairing.verify_prepared_pl(pubs, sigs, msgs)
+        elif sharded:
+            ok = self._verify_sharded(jnp.asarray(pubs), jnp.asarray(sigs),
+                                      jnp.asarray(msgs))
         else:
             ok = self._verify(jnp.asarray(pubs), jnp.asarray(sigs),
                               jnp.asarray(msgs))

@@ -640,6 +640,52 @@ def _neg_g1_np():
     return _NEG_G1_NP
 
 
+_SHARDED_VERIFY_CACHE: dict = {}
+
+
+def _sharded_verify_fn(mesh, b_local: int):
+    """Build (once per (mesh, b_local)) the shard_map-wrapped grid verify
+    — fresh closures per call would defeat jax's dispatch cache on the
+    catchup hot path."""
+    key = (mesh, b_local)
+    fn = _SHARDED_VERIFY_CACHE.get(key)
+    if fn is None:
+        try:
+            from jax import shard_map
+        except ImportError:  # jax < 0.8 layout
+            from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        axis = mesh.axis_names[0]
+
+        def local(xp, yp, q):
+            return _verify_pl_grid(xp, yp, q, npairs=2, b=b_local)
+
+        fn = jax.jit(shard_map(
+            local, mesh=mesh,
+            in_specs=(P(None, None, axis), P(None, None, axis),
+                      P(None, None, None, None, axis)),
+            out_specs=P(axis)))
+        _SHARDED_VERIFY_CACHE[key] = fn
+    return fn
+
+
+def verify_prepared_pl_sharded(pub_aff, sig_aff, msg_aff, mesh):
+    """verify_prepared_pl with the batch axis sharded over a 1-axis mesh
+    via shard_map — each device runs the grid-kernel chain on its local
+    lanes (data parallel over rounds; SURVEY §5's pjit-sharded catchup
+    design, same shape as the driver's dryrun_multichip). Requires the
+    per-device batch to be a GRID_BLOCK multiple."""
+    xp, yp, q = pack_verify_inputs(np.asarray(pub_aff), np.asarray(sig_aff),
+                                   np.asarray(msg_aff))
+    b = q.shape[-1]
+    ndev = mesh.devices.size
+    b_local = b // ndev
+    if b % ndev or b_local % GRID_BLOCK:
+        raise ValueError(f"batch {b} not shardable over {ndev} devices")
+    return _sharded_verify_fn(mesh, b_local)(xp, yp, q)
+
+
 def verify_prepared_pl(pub_aff, sig_aff, msg_aff, use_pallas: bool = True):
     """Batched BLS verify — same contract as ops/pairing.verify_prepared
     (e(-g1, sig) * e(pub, H(msg)) == 1 per batch row) on the batch-last

@@ -51,3 +51,18 @@ def pytest_pyfunc_call(pyfuncitem):
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: async test (run by conftest)")
+    config.addinivalue_line(
+        "markers",
+        "device: device-compile-heavy test (multi-minute XLA/Mosaic "
+        "compiles on a small host)")
+    config.addinivalue_line(
+        "markers",
+        "slow: wall-clock-heavy protocol test (multi-process e2e, "
+        "100-round scale runs)")
+
+
+# Markers live with the code they describe: device-compile-heavy modules
+# (pairing/h2c/MSM graph compiles, minutes each on a 1-core host) carry
+# `pytestmark = pytest.mark.device`; multi-process/scale tests carry
+# `pytest.mark.slow`. The documented fast path (README) is
+# `-m "not device and not slow"` (~3.5 min warm).

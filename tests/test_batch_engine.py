@@ -13,6 +13,8 @@ import os
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.device
+
 from drand_tpu.chain.beacon import Beacon, message, message_v2
 from drand_tpu.crypto import batch, bls, tbls
 from drand_tpu.crypto.curves import PointG1

@@ -1,6 +1,10 @@
 """Golden tests: batch-last G2 point arithmetic + ψ fast paths
 (ops/bl_curve.py) vs the host curve and endo oracles."""
 
+import pytest
+
+pytestmark = pytest.mark.device
+
 import random
 
 import numpy as np

@@ -1,6 +1,10 @@
 """Golden tests: batch-last hash-to-G2 + decompression (ops/bl_h2c.py)
 vs the host RFC 9380 pipeline and PointG2.from_bytes."""
 
+import pytest
+
+pytestmark = pytest.mark.device
+
 import random
 
 import numpy as np

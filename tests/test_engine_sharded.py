@@ -1,0 +1,61 @@
+"""Mesh-sharded engine verification — the batch axis distributed over a
+device mesh must give identical results to the single-device engine.
+
+Runs on the conftest-provisioned 8-device virtual CPU mesh (the driver's
+dryrun_multichip validates the same pattern; real multi-chip TPU uses
+the shard_map Pallas variant). SURVEY §5: catchup verification sharded
+across chips with pjit.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.device
+import jax
+
+
+@pytest.fixture
+def mesh():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(devs[:8]), ("data",))
+
+
+def _triples(n, sk=0x515):
+    from drand_tpu.crypto import bls
+    from drand_tpu.crypto.curves import PointG1, PointG2
+    from drand_tpu.crypto.hash_to_curve import hash_to_g2
+
+    pub = PointG1.generator().mul(sk)
+    out, want = [], []
+    for i in range(n):
+        m = b"shard-%d" % i
+        sig = PointG2.from_bytes(bls.sign(sk, m), subgroup_check=False)
+        bad = i % 5 == 2
+        out.append((pub, sig, hash_to_g2(b"other" if bad else m)))
+        want.append(not bad)
+    return out, want
+
+
+def test_sharded_verify_matches_single_device(mesh):
+    from drand_tpu.ops.engine import BatchedEngine
+
+    triples, want = _triples(13)
+    single = BatchedEngine(buckets=(16,))
+    sharded = BatchedEngine(buckets=(16,), mesh=mesh)
+    out_s = single.verify_bls(triples)
+    out_m = sharded.verify_bls(triples)
+    assert list(out_s) == want
+    assert list(out_m) == want
+
+
+def test_sharded_bucket_kat_gates(mesh):
+    """The sharded path goes through the same known-answer validation."""
+    from drand_tpu.ops.engine import BatchedEngine
+
+    eng = BatchedEngine(buckets=(16,), mesh=mesh)
+    assert eng._check_bucket(16) is True
+    assert eng._bucket_ok == {16: True}

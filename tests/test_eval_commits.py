@@ -11,6 +11,8 @@ import random
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.device
+
 from drand_tpu.crypto import batch
 from drand_tpu.crypto.curves import PointG1, PointG2
 from drand_tpu.crypto.fields import R

@@ -9,6 +9,8 @@ DRAND_TPU_WIRE_PREP engine path with corruption cases.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.device
+
 import jax
 import jax.numpy as jnp
 

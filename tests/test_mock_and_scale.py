@@ -7,6 +7,8 @@ BASELINE.md config 1 (demo-style 3-of-5 x 100 rounds).
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from drand_tpu.chain.beacon import verify_beacon, verify_beacon_v2
 from drand_tpu.client import ClientError, new_client
 from drand_tpu.crypto import batch

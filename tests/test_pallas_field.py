@@ -10,6 +10,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.device
+
 from drand_tpu.crypto import fields as hf
 from drand_tpu.crypto.fields import P
 from drand_tpu.ops import bl

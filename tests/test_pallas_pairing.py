@@ -12,6 +12,8 @@ import numpy as np
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.device
+
 from drand_tpu.crypto import pairing as hp
 from drand_tpu.crypto.curves import PointG1, PointG2
 from drand_tpu.ops import bl, limb, pairing as xp_pair, tower

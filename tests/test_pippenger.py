@@ -11,6 +11,8 @@ import random
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.device
+
 import jax
 import jax.numpy as jnp
 

@@ -1,0 +1,169 @@
+"""Protobuf wire interop: byte-layout goldens and a live round-trip over
+the drand.Public service + protobuf SyncChain.
+
+Reference layouts: protobuf/drand/api.proto:36-55 (PublicRandResponse),
+protocol.proto:84-92 (SyncRequest/BeaconPacket), common.proto:44-60
+(ChainInfoPacket). The golden byte strings below are hand-derived from
+the proto3 wire spec (tag = field<<3|type, varint, length-delimited) —
+they pin OUR encoder to the ecosystem layout without generated code.
+"""
+
+import asyncio
+
+import pytest
+
+from drand_tpu.net import protowire as pw
+
+
+# ---------------------------------------------------------------------------
+# golden bytes
+# ---------------------------------------------------------------------------
+
+def test_public_rand_request_bytes():
+    # round = 7 -> field 1 varint: tag 0x08, value 0x07
+    assert pw.encode(pw.PUBLIC_RAND_REQUEST, {"round": 7}) == b"\x08\x07"
+    # round = 0 is the proto3 default: empty message
+    assert pw.encode(pw.PUBLIC_RAND_REQUEST, {"round": 0}) == b""
+    assert pw.decode(pw.PUBLIC_RAND_REQUEST, b"\x08\x07") == {"round": 7}
+    assert pw.decode(pw.PUBLIC_RAND_REQUEST, b"") == {"round": 0}
+
+
+def test_public_rand_response_bytes():
+    vals = {"round": 300, "signature": b"\xaa\xbb",
+            "previous_signature": b"\xcc",
+            "randomness": b"\x01\x02", "signature_v2": b"\xdd"}
+    # field 1 varint 300 = 0xAC 0x02; field 2 len: 0x12 0x02 aa bb;
+    # field 3: 0x1a 0x01 cc; field 4: 0x22 0x02 01 02; field 5: 0x2a 0x01 dd
+    expect = (b"\x08\xac\x02" b"\x12\x02\xaa\xbb" b"\x1a\x01\xcc"
+              b"\x22\x02\x01\x02" b"\x2a\x01\xdd")
+    assert pw.encode(pw.PUBLIC_RAND_RESPONSE, vals) == expect
+    assert pw.decode(pw.PUBLIC_RAND_RESPONSE, expect) == vals
+
+
+def test_sync_request_and_beacon_packet_bytes():
+    assert pw.encode(pw.SYNC_REQUEST, {"from_round": 1}) == b"\x08\x01"
+    b = pw.encode(pw.BEACON_PACKET,
+                  {"previous_sig": b"\x11", "round": 2,
+                   "signature": b"\x22\x33"})
+    assert b == b"\x0a\x01\x11" b"\x10\x02" b"\x1a\x02\x22\x33"
+    back = pw.decode(pw.BEACON_PACKET, b)
+    assert back == {"previous_sig": b"\x11", "round": 2,
+                    "signature": b"\x22\x33"}
+
+
+def test_chain_info_packet_negative_genesis():
+    # proto3 int64: negative values are 10-byte varints
+    vals = {"public_key": b"\x01", "period": 30, "genesis_time": -1,
+            "hash": b"", "group_hash": b""}
+    enc = pw.encode(pw.CHAIN_INFO_PACKET, vals)
+    assert pw.decode(pw.CHAIN_INFO_PACKET, enc)["genesis_time"] == -1
+
+
+def test_unknown_fields_skipped():
+    # field 15 (unknown to PUBLIC_RAND_REQUEST), then round=3
+    data = b"\x7a\x02\xff\xff" + b"\x08\x03"
+    assert pw.decode(pw.PUBLIC_RAND_REQUEST, data)["round"] == 3
+
+
+def test_truncated_raises():
+    with pytest.raises(pw.WireError):
+        pw.decode(pw.PUBLIC_RAND_RESPONSE, b"\x12\x05\xaa")
+
+
+# ---------------------------------------------------------------------------
+# live round-trip: ecosystem-style client against our gateway
+# ---------------------------------------------------------------------------
+
+class _Svc:
+    """Minimal Public + sync service over a fixed small chain."""
+
+    def __init__(self, beacons, info):
+        self._b = {b.round: b for b in beacons}
+        self._last = max(self._b)
+        self._info = info
+
+    async def public_rand(self, from_addr, round_no):
+        from drand_tpu.net.transport import TransportError
+
+        b = self._b.get(round_no or self._last)
+        if b is None:
+            raise TransportError(f"no round {round_no}")
+        return b
+
+    async def public_rand_stream(self, from_addr):
+        for r in sorted(self._b):
+            yield self._b[r]
+
+    async def chain_info(self, from_addr):
+        return self._info
+
+    async def sync_chain(self, from_addr, req):
+        for r in sorted(self._b):
+            if r >= req.from_round:
+                yield self._b[r]
+
+
+@pytest.mark.asyncio
+async def test_interop_public_service_roundtrip():
+    from drand_tpu.chain.beacon import Beacon
+    from drand_tpu.chain.info import Info
+    from drand_tpu.client.grpc_interop import GrpcInteropSource
+    from drand_tpu.crypto.curves import PointG1
+    from drand_tpu.net.grpc_transport import GrpcGateway
+
+    pub = PointG1.generator().mul(0x1234)
+    info = Info(public_key=pub, period=30, genesis_time=1700000000,
+                genesis_seed=b"\x07" * 32, group_hash=b"\x09" * 32)
+    beacons = [Beacon(round=r, previous_sig=b"p%d" % r,
+                      signature=b"s%d" % r, signature_v2=b"v%d" % r)
+               for r in (1, 2, 3)]
+    gw = GrpcGateway(_Svc(beacons, info), "127.0.0.1:0")
+    await gw.start()
+    try:
+        src = GrpcInteropSource(f"127.0.0.1:{gw.port}")
+        got_info = await src.info()
+        assert got_info.public_key == pub
+        assert got_info.period == 30
+        assert got_info.genesis_time == 1700000000
+        assert got_info.group_hash == b"\x09" * 32
+        r2 = await src.get(2)
+        assert r2.round == 2 and r2.signature == b"s2"
+        rows = []
+        async for r in src.watch():
+            rows.append(r.round)
+        assert rows == [1, 2, 3]
+        await src.close()
+    finally:
+        await gw.stop()
+
+
+@pytest.mark.asyncio
+async def test_interop_protobuf_sync_chain():
+    """A protobuf SyncRequest on the standard method streams protobuf
+    BeaconPackets (codec sniffing on the shared handler)."""
+    import grpc.aio
+
+    from drand_tpu.chain.beacon import Beacon
+    from drand_tpu.chain.info import Info
+    from drand_tpu.crypto.curves import PointG1
+    from drand_tpu.net.grpc_transport import GrpcGateway
+
+    info = Info(public_key=PointG1.generator(), period=30,
+                genesis_time=1, genesis_seed=b"", group_hash=b"")
+    beacons = [Beacon(round=r, previous_sig=b"p", signature=b"s%d" % r)
+               for r in (1, 2, 3)]
+    gw = GrpcGateway(_Svc(beacons, info), "127.0.0.1:0")
+    await gw.start()
+    try:
+        ch = grpc.aio.insecure_channel(f"127.0.0.1:{gw.port}")
+        stream = ch.unary_stream("/drand.Protocol/SyncChain")(
+            pw.encode(pw.SYNC_REQUEST, {"from_round": 2}))
+        rounds = []
+        async for raw in stream:
+            msg = pw.decode(pw.BEACON_PACKET, raw)
+            rounds.append(msg["round"])
+            assert msg["signature"] == b"s%d" % msg["round"]
+        assert rounds == [2, 3]
+        await ch.close()
+    finally:
+        await gw.stop()
