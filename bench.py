@@ -82,7 +82,8 @@ def bench_headline(trials, min_seconds):
     from drand_tpu.ops import limb, pallas_pairing as pp
 
     batches = [int(b) for b in
-               os.environ.get("BENCH_BATCH", "512,128,16,8,4").split(",")]
+               os.environ.get("BENCH_BATCH", "512,128,8").split(",")]
+    measured = 0
     sk = 0x1F3A
     pub_aff, pool_sigs, pool_msgs, _ = _mk_pool(sk)
     best_rate = None
@@ -131,12 +132,16 @@ def bench_headline(trials, min_seconds):
         k = max(4, int(min_seconds / max(est, 1e-4)))
 
         def timed():
+            import jax.numpy as jnp
+
             t0 = time.perf_counter()
             outs = [verify(args_ok) for _ in range(k)]
             outs[-1].block_until_ready()
             dt = time.perf_counter() - t0
-            res = [np.asarray(o) for o in outs]
-            if not all(r.all() for r in res):
+            # one stacked transfer: per-array d2h pays a ~100 ms polling
+            # floor through the tunnel even for completed results
+            res = np.asarray(jnp.stack(outs))
+            if not res.all():
                 raise RuntimeError("self-check failed inside timed loop")
             return dt / k
 
@@ -146,6 +151,9 @@ def bench_headline(trials, min_seconds):
             f"-> {rate:.0f} pairings/s")
         if best_rate is None or rate > best_rate[0]:
             best_rate = (rate, batch, per_call)
+        measured += 1
+        if measured >= 2:
+            break  # two good sizes suffice; smaller ones are fallbacks
     if best_rate is None:
         log("FATAL: no batch size produced correct results")
         raise SystemExit(1)
@@ -204,7 +212,7 @@ def bench_catchup(trials, n_rounds=10_000):
             "path": path, "vs_baseline": None}
 
 
-def bench_recover(trials, t=67, n=100, k_rounds=3):
+def bench_recover(trials, t=67, n=100, k_rounds=2):
     """67-of-100 round: verify all partials + Lagrange-recover + verify
     the recovered signature — the aggregator's per-round work
     (chain/beacon/chain.go:91-166) at League-of-Entropy-plus scale."""
@@ -220,11 +228,13 @@ def bench_recover(trials, t=67, n=100, k_rounds=3):
     partials = [tbls.sign_partial(s, msg) for s in poly.shares(n)]
     eng = cbatch.engine()
 
-    # warm + correctness
+    # warm + correctness: the recovered signature is checked
+    # CRYPTOGRAPHICALLY (VerifyRecovered) — pairing equality implies the
+    # recovery matched the unique group signature, no host re-derivation
+    # needed (67 host G2 scalar muls would cost minutes on this box)
     oks = eng.verify_partials(pub_poly, msg, partials)
     assert all(oks), "partial verification failed"
     sig = eng.recover(pub_poly, msg, partials, t, n)
-    assert sig == tbls.recover(pub_poly, msg, partials, t, n)
     assert eng.verify_sigs(pubkey, [(msg, sig)]) == [True]
 
     def timed():
@@ -265,10 +275,10 @@ def bench_deal_verify(trials, n=128):
     eng = cbatch.engine()
     g = PointG1.generator()
 
-    # correctness both ways
+    # correctness: the deal check g·s == eval is itself the oracle (the
+    # engine's eval KAT covers device-vs-host; a full 128×t host eval
+    # here would cost minutes on this box)
     dev = eng.eval_commits(pubs, my_index)
-    host = [p.eval(my_index).value for p in pubs]
-    assert dev == host, "device eval mismatch"
     assert all(g.mul(s) == e for s, e in zip(shares, dev))
 
     def timed_dev():
@@ -298,7 +308,7 @@ def bench_deal_verify(trials, n=128):
             "vs_baseline": None}
 
 
-def bench_e2e(trials=1, n=5, t=3, rounds=6):
+def bench_e2e(trials=1, n=5, t=3, rounds=4):
     """3-of-5 network end-to-end on the in-process harness (fake clock,
     real crypto/aggregation; demo/main.go:41-45 analogue). This config is
     a protocol-liveness measurement: live rounds are latency-bound (a
@@ -370,33 +380,61 @@ def main() -> None:
 
     trials = int(os.environ.get("BENCH_TRIALS", "2"))
     min_seconds = float(os.environ.get("BENCH_MIN_SECONDS", "5.0"))
+    # total wall budget: once exceeded, remaining aux configs are skipped
+    # so the HEADLINE always runs and prints last (the driver parses the
+    # final JSON line; an external kill mid-run must not leave an aux
+    # config line as the "result")
+    budget = float(os.environ.get("BENCH_BUDGET_SECONDS", "600"))
+    t_start = time.perf_counter()
     which = os.environ.get(
         "BENCH_CONFIGS", "e2e,catchup,recover,deal,replay,headline").split(",")
     log(f"backend={jax.default_backend()} devices={jax.devices()} "
-        f"configs={which}")
+        f"configs={which} budget={budget}s")
+
+    def have_time(section):
+        left = budget - (time.perf_counter() - t_start)
+        if left <= 0:
+            log(f"== skipping {section}: budget exhausted ==")
+            return False
+        return True
+
+    def section(name, fn):
+        t0 = time.perf_counter()
+        out = fn()
+        log(f"== {name} done in {time.perf_counter() - t0:.0f}s "
+            f"(elapsed {time.perf_counter() - t_start:.0f}s) ==")
+        return out
 
     results = {}
-    if "e2e" in which:
-        log("== e2e 3-of-5 x 100 rounds ==")
-        results["e2e"] = bench_e2e()
-        emit(results["e2e"])
-    if "catchup" in which:
-        log("== catchup 10k rounds (wire path) ==")
-        results["catchup"] = bench_catchup(trials)
-        if results["catchup"]:
-            emit(results["catchup"])
-    if "recover" in which:
-        log("== 67-of-100 verify+recover ==")
-        results["recover"] = bench_recover(trials)
-        emit(results["recover"])
-    if "deal" in which:
-        log("== n=128 deal verify ==")
-        results["deal"] = bench_deal_verify(trials)
-        emit(results["deal"])
     headline = None
     if "headline" in which:
+        # headline runs FIRST: it warms the grid verify executables that
+        # recover/deal reuse (the axon remote compiler re-processes each
+        # kernel chain once per process, ~2 min per batch shape, and the
+        # local persistent cache does not cover it) — but PRINTS last.
         log("== headline pairings/s ==")
-        headline = bench_headline(trials, min_seconds)
+        headline = section("headline", lambda: bench_headline(
+            trials, min_seconds))
+    # aux configs in decreasing information order; e2e (protocol
+    # liveness, measured elsewhere by the test suite) goes last
+    if "catchup" in which and have_time("catchup"):
+        log("== catchup 10k rounds (wire path) ==")
+        results["catchup"] = section("catchup", lambda: bench_catchup(trials))
+        if results["catchup"]:
+            emit(results["catchup"])
+    if "recover" in which and have_time("recover"):
+        log("== 67-of-100 verify+recover ==")
+        results["recover"] = section("recover",
+                                     lambda: bench_recover(trials))
+        emit(results["recover"])
+    if "deal" in which and have_time("deal"):
+        log("== n=128 deal verify ==")
+        results["deal"] = section("deal", lambda: bench_deal_verify(trials))
+        emit(results["deal"])
+    if "e2e" in which and have_time("e2e"):
+        log("== e2e 3-of-5 x 100 rounds ==")
+        results["e2e"] = section("e2e", bench_e2e)
+        emit(results["e2e"])
     if "replay" in which and (results.get("catchup") or headline):
         results["replay"] = bench_replay_1m(results.get("catchup"), headline)
         emit(results["replay"])

@@ -26,7 +26,12 @@ def generate_self_signed(address: str, folder: str,
 
     host = address.rsplit(":", 1)[0]
     key = ec.generate_private_key(ec.SECP256R1())
-    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, host)])
+    # CN = the FULL address, not just the host: hostname validation uses
+    # the SAN, but the root store looks roots up BY SUBJECT — multiple
+    # self-signed certs sharing a subject (every node on 127.0.0.1) make
+    # BoringSSL try the wrong root and fail the handshake in pools of 3+
+    # (found via the multi-node TLS integration run)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, address)])
     try:
         san = x509.SubjectAlternativeName(
             [x509.IPAddress(ipaddress.ip_address(host))])

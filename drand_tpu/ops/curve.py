@@ -404,6 +404,29 @@ def msm_scan(F, points, bits):
     return acc
 
 
+def msm_lanes(F, points, bits):
+    """MSM as per-lane scalar ladders + a log-tree lane reduction: each
+    of the n points runs its own double-and-add (vectorized across the
+    batch — one 255-step scan), then the n per-lane results fold in
+    log2(n) cross-lane adds. Sequential depth ~nbits + log2(n) ≈ 520 ops
+    vs ~nbits·n for :func:`msm_scan` — the one-shot recovery MSM that is
+    neither a compile bomb (msm/msm_pippenger unroll over points) nor
+    latency-bound. Requires n to be a power of two (bucket-padded).
+
+    points: device point with batch shape (n,); bits: (n, nbits)
+    MSB-first. Returns sum_i bits_i ⋅ points_i (batch shape ()).
+    """
+    n = points[3].shape[-1]
+    if n & (n - 1):
+        raise ValueError(f"msm_lanes needs a power-of-two batch, got {n}")
+    acc = pt_mul_bits(F, points, bits)
+    width = n
+    while width > 1:
+        width //= 2
+        acc = _pt_axis_pairs(F, acc, width)
+    return _pt_index(F, acc, 0)
+
+
 def msm(F, points, bits):
     """Multi-scalar multiplication over the trailing *points* axis.
 

@@ -60,15 +60,17 @@ PALLAS_MIN_BUCKET = int(os.environ.get("DRAND_TPU_PALLAS_MIN", "32"))
 WIRE_MAX_BUCKET = 128
 
 
-def _drain(launches) -> None:
-    """Block once on the LAST launch before pulling results: the device
-    executes launches in order, so when the last completes they all have
-    — while draining in-flight outputs one by one pays the remote
-    transport's ~100 ms polling floor per output."""
-    for dev, _, _ in reversed(launches):
-        if hasattr(dev, "block_until_ready"):
-            dev.block_until_ready()
-        break
+def _drain(launches) -> np.ndarray:
+    """Collect per-bucket outputs with ONE device-side stack and ONE
+    host transfer. Through the remote transport, every d2h transfer —
+    even of a completed (b,) bool array — pays a ~100 ms polling floor
+    (measured: 79 separate np.asarray drains cost 7.5 s after all
+    compute finished); stacking on device first makes it one floor
+    total. Returns the stacked (n_buckets, b) bool array."""
+    devs = [dev for dev, _, _ in launches]
+    if len(devs) == 1:
+        return np.asarray(devs[0])[None]
+    return np.asarray(jnp.stack(devs))
 
 
 def _pallas_ok(b: int) -> bool:
@@ -145,9 +147,9 @@ class BatchedEngine:
         self._msm_g2_pip = jax.jit(
             lambda pts, bits: curve.pt_to_affine(
                 curve.F2, curve.msm_pippenger(curve.F2, pts, bits)))
-        self._msm_g2_scan = jax.jit(
+        self._msm_g2_lanes = jax.jit(
             lambda pts, bits: curve.pt_to_affine(
-                curve.F2, curve.msm_scan(curve.F2, pts, bits)))
+                curve.F2, curve.msm_lanes(curve.F2, pts, bits)))
         self._msg_cache: dict[tuple[bytes, bytes], PointG2] = {}
         # wire-prep: hash-to-curve + decompression + subgroup checks run
         # on the DEVICE (Pallas kernels at bucket >= PALLAS_MIN_BUCKET,
@@ -169,6 +171,7 @@ class BatchedEngine:
         self._bucket_ok: dict[int, bool] = {}
         self._wire_ok: dict[int, bool] = {}
         self._eval_ok: dict[tuple[int, int], bool] = {}
+        self._poly_eval_ok: dict[tuple[int, int], bool] = {}
 
     @staticmethod
     def _wire_graph(pub_aff, sig_x, sig_sign, u_pairs):
@@ -275,9 +278,9 @@ class BatchedEngine:
                 "device engine: no bucket passed known-answer validation")
         launches = [self._launch_bucket(triples[i:i + b], b)
                     for i in range(0, n, b)]
-        _drain(launches)
-        return np.concatenate([(np.asarray(dev) & valid)[:c]
-                               for dev, valid, c in launches])
+        stacked = _drain(launches)
+        return np.concatenate([(stacked[j] & valid)[:c]
+                               for j, (_, valid, c) in enumerate(launches)])
 
     def _launch_bucket(self, triples, b: int):
         """Dispatch one padded bucket; returns (device_out, valid, count)
@@ -441,9 +444,9 @@ class BatchedEngine:
                 "device engine: no wire bucket passed validation")
         launches = [self._launch_wire_bucket(pubkey, checks[i:i + b], b, dst)
                     for i in range(0, n, b)]
-        _drain(launches)
-        return np.concatenate([(np.asarray(dev) & valid)[:c]
-                               for dev, valid, c in launches])
+        stacked = _drain(launches)
+        return np.concatenate([(stacked[j] & valid)[:c]
+                               for j, (_, valid, c) in enumerate(launches)])
 
     def _launch_wire_bucket(self, pubkey: PointG1, checks, b: int,
                             dst: bytes = DEFAULT_DST_G2):
@@ -485,8 +488,27 @@ class BatchedEngine:
 
     def verify_partials(self, pub_poly: PubPoly, msg: bytes, partials,
                         dst: bytes = DEFAULT_DST_G2) -> list[bool]:
-        """All partials of one round against their public key shares."""
+        """All partials of one round against their public key shares.
+        The per-index public keys come from ONE batched device Horner
+        over the commitment polynomial (the host loop costs ~10 point
+        ops per coefficient per index — seconds at 67-of-100 scale)."""
         msg_pt = self._hash_msg(msg, dst)
+        idxs = sorted({tbls.index_of(p) for p in partials
+                       if len(p) == tbls.PARTIAL_SIG_SIZE})
+        # out-of-ladder-range indices (garbage partials) fall back to the
+        # per-index host eval below rather than aborting the device batch
+        # for everyone — their signatures fail verification regardless
+        need = [i for i in idxs if i not in pub_poly._eval_cache
+                and 0 <= i + 1 < (1 << _EVAL_IDX_BITS)]
+        if need:
+            try:
+                evals = self.eval_poly_indices(pub_poly, need)
+                from ..crypto.poly import PubShare
+
+                for i, v in zip(need, evals):
+                    pub_poly._eval_cache[i] = PubShare(i, v)
+            except Exception:  # noqa: BLE001 — host oracle fallback
+                pass  # pub_poly.eval below computes host-side
         triples = []
         for p in partials:
             if len(p) != tbls.PARTIAL_SIG_SIZE:
@@ -496,6 +518,74 @@ class BatchedEngine:
             triples.append((pub_poly.eval(idx).value,
                             _decode_sig(p[tbls.INDEX_BYTES:]), msg_pt))
         return [bool(v) for v in self.verify_bls(triples)]
+
+    def eval_poly_indices(self, pub_poly: PubPoly,
+                          indices: list[int]) -> list[PointG1]:
+        """ONE polynomial evaluated at MANY indices — the dual of
+        eval_commits: commits broadcast across lanes, per-lane index
+        bits through the same KAT-gated Horner graph."""
+        n = len(indices)
+        if n == 0:
+            return []
+        for i in indices:
+            if not 0 <= i + 1 < (1 << _EVAL_IDX_BITS):
+                raise ValueError("index out of range")
+        if any(c.is_infinity() for c in pub_poly.commits):
+            return [pub_poly.eval(i).value for i in indices]
+        t = len(pub_poly.commits)
+        eb = [b for b in self.buckets if b >= 32] or [128]
+        b = self._good_bucket(
+            n, check=lambda bb: self._check_poly_eval_bucket(t, bb),
+            buckets=eb)
+        if b is None:
+            raise RuntimeError(
+                "device engine: no eval bucket passed validation")
+        out = []
+        for s in range(0, n, b):
+            out.extend(self._run_poly_eval_bucket(
+                pub_poly, indices[s:s + b], b))
+        return out
+
+    def _check_poly_eval_bucket(self, t: int, b: int) -> bool:
+        """KAT for the many-indices mode — a DIFFERENT executable from
+        eval_commits' shared-index mode (per-lane bits), gated and cached
+        independently so a failure here never disables the other."""
+        key = (t, b)
+        ok = self._poly_eval_ok.get(key)
+        if ok is not None:
+            return ok
+        g = PointG1.generator()
+        poly = PubPoly([g.mul(1 + k) for k in range(t)])
+        probe_idx = [0, 3, 7][:min(3, b)]
+        try:
+            got = self._run_poly_eval_bucket(poly, probe_idx, b)
+            ok = got == [poly.eval(i).value for i in probe_idx]
+        except Exception:  # noqa: BLE001 — trace/lowering failures too
+            ok = False
+        self._poly_eval_ok[key] = ok
+        if not ok:
+            from ..utils.logging import default_logger
+
+            default_logger("engine").warn(
+                "engine", "poly_eval_bucket_disabled", t=t, bucket=b)
+        return ok
+
+    def _run_poly_eval_bucket(self, pub_poly, indices, b: int):
+        t = len(pub_poly.commits)
+        xs = np.zeros((t, b, limb.NLIMBS), np.int32)
+        ys = np.zeros((t, b, limb.NLIMBS), np.int32)
+        flat = PointG1.batch_to_affine(pub_poly.commits)
+        for k in range(t):
+            aff = _g1_xy(flat[k])
+            xs[k, :] = aff[0]
+            ys[k, :] = aff[1]
+        bits = np.zeros((b, _EVAL_IDX_BITS), np.int32)
+        for j, idx in enumerate(indices):
+            bits[j] = curve.scalar_to_bits(idx + 1, _EVAL_IDX_BITS)
+        # pad lanes evaluate at abscissa 0 — harmless, sliced away
+        dev = _eval_commits_graph(
+            jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(bits), t=t)
+        return self._unpack_eval(dev, len(indices))
 
     # ------------------------------------------------- commitment evals
     def eval_commits(self, polys, index: int) -> list[PointG1]:
@@ -654,10 +744,11 @@ class BatchedEngine:
         pts = (jnp.asarray(pts_np[:, 0]), jnp.asarray(pts_np[:, 1]),
                jnp.asarray(z_one), jnp.asarray(inf))
         if jax.default_backend() == "tpu" and b > self.PIPPENGER_MIN_T:
-            # compile-friendly path: the unrolled ladder/window graphs
-            # take >10 min to build at b=128 on the XLA limb path; the
-            # one-per-round recovery is latency-tolerant (see msm_scan)
-            msm_fn = self._msm_g2_scan
+            # per-lane ladders + log-tree fold (msm_lanes): the unrolled
+            # ladder/window graphs take >10 min to COMPILE at b=128 on
+            # the XLA limb path, and a fully-sequential scan is
+            # latency-fragile through the tunnel (~nbits·n depth)
+            msm_fn = self._msm_g2_lanes
         else:
             msm_fn = (self._msm_g2_pip if b >= self.PIPPENGER_MIN_T
                       else self._msm_g2)

@@ -6,6 +6,21 @@ or re-forwarding (client/validator.go:16-69 rejects future rounds and bad
 signatures so invalid data never propagates). libp2p is not in this image,
 so the mesh is explicit peers over a grpc.aio "drand.Gossip" service with
 hash dedup — the same flood/validate semantics on a static topology.
+
+Delta vs the reference's libp2p gossipsub, for operators:
+- NO peer discovery (lp2p uses DHT bootstrap + pubsub peer exchange):
+  the mesh topology is the --peers list; adding a relay means telling
+  its neighbours. The public-topic interop surface
+  (/drand/pubsub/v0.0.0/<chainHash>) therefore cannot be joined — use
+  the drand.Public protobuf service (net/protowire.py) for ecosystem
+  interop instead.
+- NO peer scoring/pruning (gossipsub v1.1): a misbehaving peer is
+  bounded by validation (invalid beacons never forward; per-message
+  hash dedup caps amplification at one delivery per peer per message)
+  but stays in the mesh; drop it from --peers to evict.
+- Flood (every message to every peer) instead of mesh-degree-bounded
+  gossip: per-message cost is O(peers), the right trade at the handful-
+  of-relays scale this deployment targets.
 """
 
 from __future__ import annotations

@@ -64,7 +64,7 @@ def test_eval_commits_via_batch_dispatch():
         batch.configure(prev[0], min_batch=prev[1])
 
 
-def test_msm_scan_matches_unrolled():
+def test_msm_scan_and_lanes_match_unrolled():
     import jax.numpy as jnp
 
     from drand_tpu.ops import curve, limb
@@ -119,3 +119,65 @@ def test_verify_bls_async_chunking(engine):
     small = type(engine)(buckets=(4,))
     out = small.verify_bls(triples)
     assert list(out) == want
+
+
+def test_eval_poly_indices_matches_host(engine):
+    from drand_tpu.crypto.poly import PriPoly
+
+    poly = PriPoly.random(6, seed=b"epi").commit()
+    idxs = [0, 2, 9, 33, 5]
+    got = engine.eval_poly_indices(poly, idxs)
+    assert got == [poly.eval(i).value for i in idxs]
+
+
+def test_verify_partials_uses_batched_evals(engine):
+    from drand_tpu.crypto import tbls
+    from drand_tpu.crypto.poly import PriPoly
+
+    pri = PriPoly.random(3, seed=b"vp")
+    pub = pri.commit()
+    msg = b"round-msg"
+    partials = [tbls.sign_partial(s, msg) for s in pri.shares(7)]
+    oks = engine.verify_partials(pub, msg, partials)
+    assert oks == [True] * 7
+    bad = bytearray(partials[2])
+    bad[-1] ^= 1
+    oks = engine.verify_partials(pub, msg, [bytes(bad)] + partials[:2])
+    assert oks == [False, True, True]
+
+
+def test_msm_lanes_matches_host():
+    import jax.numpy as jnp
+
+    from drand_tpu.ops import curve, limb
+    from drand_tpu.ops.engine import _g2_aff
+    from drand_tpu.crypto.fields import Fp2
+
+    rnd = random.Random(5)
+    n = 8  # power of two incl. masked (infinity) pad lanes
+    pts_h = [PointG2.generator().mul(rnd.randrange(1, R)) for _ in range(6)]
+    scals = [rnd.randrange(R) for _ in range(6)]
+    exp = None
+    for p, s in zip(pts_h, scals):
+        q = p.mul(s)
+        exp = q if exp is None else exp + q
+    pts_np = np.stack([_g2_aff(p) for p in pts_h] +
+                      [_g2_aff(PointG2.generator())] * 2)
+    z_one = np.zeros((n, 2, limb.NLIMBS), np.int32)
+    z_one[:, 0] = np.asarray(limb.ONE_MONT)
+    inf = np.zeros(n, bool)
+    inf[6:] = True  # pad lanes masked out
+    bits = np.stack([curve.scalar_to_bits(s, 255) for s in scals] +
+                    [np.zeros(255, np.int32)] * 2)
+    pts = (jnp.asarray(pts_np[:, 0]), jnp.asarray(pts_np[:, 1]),
+           jnp.asarray(z_one), jnp.asarray(inf))
+    ax, ay, is_inf = curve.pt_to_affine(
+        curve.F2, curve.msm_lanes(curve.F2, pts, jnp.asarray(bits)))
+    got = PointG2(
+        Fp2(limb.fp_from_device(np.asarray(ax)[0]),
+            limb.fp_from_device(np.asarray(ax)[1])),
+        Fp2(limb.fp_from_device(np.asarray(ay)[0]),
+            limb.fp_from_device(np.asarray(ay)[1])),
+        Fp2.one())
+    assert not bool(np.asarray(is_inf))
+    assert got == exp

@@ -131,3 +131,29 @@ async def test_tls_transport_roundtrip(tmp_path):
     finally:
         await gw.stop()
         net.stop_all()
+
+
+@pytest.mark.asyncio
+async def test_tls_multi_cert_pool_same_host(tmp_path):
+    """Root pools holding SEVERAL self-signed node certs for the same
+    host must validate against any of them — regression for the subject
+    collision that broke 3+-node TLS meshes (BoringSSL looks roots up by
+    subject; certs now carry the full address as CN so subjects are
+    unique per node)."""
+    certs = [tls.generate_self_signed(f"127.0.0.1:{30000 + i}",
+                                      str(tmp_path / f"n{i}"))
+             for i in range(3)]
+    net, gw, addr = await _make_live_gateway(tls_pair=certs[0])
+    try:
+        pool = tls.CertManager()
+        # server's cert LAST: the order that failed with colliding CNs
+        pool.add(certs[1][0])
+        pool.add(certs[2][0])
+        pool.add(certs[0][0])
+        client = GrpcClient(own_addr="pool-client", certs=pool)
+        b = await client.public_rand(addr, 1)
+        assert b.round == 1
+        await client.close()
+    finally:
+        await gw.stop()
+        net.stop_all()
