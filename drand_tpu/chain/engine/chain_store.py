@@ -130,35 +130,42 @@ class ChainStore(CallbackStore):
 
     def _aggregate(self, rc, thr: int, n: int) -> Beacon | None:
         """Recover + verify V1 and (when possible) V2 — the crypto hot path
-        (chain/beacon/chain.go:136-166). Recovery and the final checks go
-        through the batch dispatch (crypto/batch.py): both re-verifications
-        run as ONE device call when the engine is active."""
+        (chain/beacon/chain.go:136-166). Each chain's whole round work
+        (partial re-verify + Lagrange recovery + recovered-signature
+        check) is ONE fused device dispatch when the engine is active
+        (batch.aggregate_round); recovery failure AND a recovered
+        signature failing its pairing check both surface as ValueError.
+        Partials were already signature-checked on ingress (handler.py),
+        so the in-graph re-verify costs no extra dispatches."""
+        from ...crypto.tbls import RecoveredSignatureInvalid
+
         pub = self._crypto.get_pub()
         msg = rc.msg()
         try:
-            final_sig = batch.recover(pub, msg, rc.partials(), thr, n)
+            _, final_sig = batch.aggregate_round(
+                pub, msg, rc.partials(), thr, n, prevalidated=True)
+        except RecoveredSignatureInvalid as e:
+            # security-significant: individually-valid partials produced
+            # an invalid group signature (byzantine member / corruption)
+            self._l.error("aggregator", "invalid_sig", err=str(e), round=rc.round)
+            return None
         except ValueError as e:
             self._l.debug("aggregator", "invalid_recovery", err=str(e), round=rc.round)
             return None
         b = Beacon(round=rc.round, previous_sig=rc.prev, signature=final_sig)
-        checks = [(msg, final_sig)]
-        sig_v2 = b""
         if rc.len_v2() >= thr:
             msg_v2 = chain_beacon.message_v2(rc.round)
             try:
-                sig_v2 = batch.recover(pub, msg_v2, rc.partials_v2(), thr, n)
+                _, sig_v2 = batch.aggregate_round(
+                    pub, msg_v2, rc.partials_v2(), thr, n,
+                    prevalidated=True)
+            except RecoveredSignatureInvalid as e:
+                self._l.error("aggregator", "invalid_sig_v2", err=str(e),
+                              round=rc.round)
+                return None
             except ValueError as e:
                 self._l.debug("aggregator", "invalid_recovery_v2", err=str(e))
                 return None  # never accept a beacon whose V2 fails to recover
-            checks.append((msg_v2, sig_v2))
-        oks = batch.verify_recovered_many(pub.commit(), checks)
-        if not oks[0]:
-            self._l.error("aggregator", "invalid_sig", round=rc.round)
-            return None
-        if sig_v2:
-            if not oks[1]:
-                self._l.error("aggregator", "invalid_sig_v2", round=rc.round)
-                return None
             b.signature_v2 = sig_v2
         return b
 

@@ -77,6 +77,12 @@ async def _run_daemon(args) -> None:
     ks = FileStore(folder)
     if not ks.has_key_pair():
         raise SystemExit(f"no keypair in {folder}; run generate-keypair first")
+    # warm the device-backend probe off the event loop: by the time the
+    # first round aggregates, engine() finds a verdict (down tunnel =>
+    # permanent host fallback, never a hang — utils/backend.py)
+    from ..utils.backend import probe_backend_bg
+
+    probe_backend_bg()
     logger = default_logger("drand", level=args.verbose and "debug" or "info")
     conf = Config(folder=folder, control_port=args.control,
                   db_path=os.path.join(folder, "db", "chain.db"),

@@ -63,11 +63,47 @@ def configure(mode: str, min_batch: int | None = None, engine=None) -> None:
 
 
 def engine():
-    """The lazily-created device engine, or None in host mode."""
+    """The lazily-created device engine, or None in host mode.
+
+    Guarded by a hang-safe subprocess probe: creating ``BatchedEngine``
+    initializes the jax backend, and under axon that init HANGS (not
+    raises) when the TPU tunnel is down — which would freeze the daemon's
+    event loop forever. The probe (utils/backend.probe_backend) answers
+    "would init hang?" from a killable child, and warms the in-process
+    backend on success.
+
+    Event-loop callers never block here: with no verdict yet the probe is
+    kicked onto a background thread and this call raises
+    ``BackendUnavailable`` — the dispatch wrappers fall back to host
+    crypto until the probe lands (the daemon warms it at startup, so in
+    practice only the first post-boot rounds are affected). Synchronous
+    callers (bench, CLI one-shots) block on the probe once."""
     global _ENGINE
     if _MODE == "host":
         return None
     if _ENGINE is None:
+        import asyncio
+
+        from ..utils.backend import (BackendUnavailable, probe_backend,
+                                     probe_backend_bg, probe_state)
+
+        st = probe_state()
+        if st is None:
+            try:
+                asyncio.get_running_loop()
+                in_loop = True
+            except RuntimeError:
+                in_loop = False
+            if in_loop:
+                probe_backend_bg()
+                raise BackendUnavailable(
+                    "jax backend probe in progress — host crypto fallback "
+                    "for this call")
+            st = probe_backend()
+        if not st:
+            raise BackendUnavailable(
+                "jax backend probe failed (tunnel down?) — host crypto "
+                "fallback in effect for this process")
         from ..ops.engine import BatchedEngine
 
         _ENGINE = BatchedEngine()
@@ -150,6 +186,43 @@ def recover(pub_poly: PubPoly, msg: bytes, partials, t: int, n: int,
                 raise
             _note_fallback("recover", e)
     return tbls.recover(pub_poly, msg, partials, t, n, dst)
+
+
+def aggregate_round(pub_poly: PubPoly, msg: bytes, partials, t: int, n: int,
+                    dst: bytes = DEFAULT_DST_G2, *,
+                    prevalidated: bool = False):
+    """The aggregator's whole per-round crypto — verify every partial,
+    Lagrange-recover, verify the recovered signature — as ONE device
+    dispatch when the engine is active (chain/beacon/chain.go:91-166).
+    Returns ``(oks, sig_bytes)`` with ``oks`` aligned to ``partials``.
+    Raises ``ValueError`` when recovery is impossible.
+
+    ``prevalidated``: the caller already signature-checked every partial
+    on ingress (the daemon's handler path) — the host fallback then skips
+    the per-partial pairings (the fused device graph re-verifies anyway,
+    at zero extra dispatches)."""
+    if _use_device(len(partials)):
+        try:
+            return engine().aggregate_round(pub_poly, msg, partials, t, n,
+                                            dst)
+        except ValueError:
+            raise  # semantic error: no fallback
+        except Exception as e:  # noqa: BLE001
+            if _MODE == "device":
+                raise
+            _note_fallback("aggregate_round", e)
+    if prevalidated:
+        oks = [len(p) == tbls.PARTIAL_SIG_SIZE for p in partials]
+    else:
+        oks = [tbls.verify_partial(pub_poly, msg, p, dst) for p in partials]
+    good = [p for p, ok in zip(partials, oks) if ok]
+    if len(good) < t:
+        raise ValueError(f"not enough valid partials: {len(good)} < {t}")
+    sig = tbls.recover(pub_poly, msg, good, t, n, dst)
+    if not tbls.verify_recovered(pub_poly.commit(), msg, sig, dst):
+        raise tbls.RecoveredSignatureInvalid(
+            "recovered signature failed verification")
+    return oks, sig
 
 
 def eval_commits(polys: list[PubPoly], index: int) -> list[PointG1]:

@@ -26,6 +26,13 @@ PARTIAL_SIG_SIZE = INDEX_BYTES + PointG2.COMPRESSED_SIZE  # 98
 SIG_SIZE = PointG2.COMPRESSED_SIZE  # 96
 
 
+class RecoveredSignatureInvalid(ValueError):
+    """The Lagrange-recovered group signature failed its pairing check —
+    security-significant (byzantine partials that individually verified,
+    or state corruption), distinct from the routine not-enough-partials
+    ValueError so callers can log it loudly."""
+
+
 def sign_partial(share: PriShare, msg: bytes, dst: bytes = DEFAULT_DST_G2) -> bytes:
     """Partial signature: index-prefixed share-scalar * H(msg)."""
     sig = hash_to_g2(msg, dst).mul(share.value)

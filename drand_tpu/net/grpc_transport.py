@@ -20,6 +20,7 @@ import grpc.aio
 
 from ..chain.beacon import Beacon
 from ..utils.logging import KVLogger, default_logger
+from . import protowire as pw
 from . import wire
 from .packets import PartialBeaconPacket, SyncRequest
 from .transport import ProtocolClient, ProtocolService, TransportError
@@ -113,14 +114,132 @@ class GrpcGateway:
 
             metrics.API_CALLS.labels(method=name).inc()
             try:
-                msg, from_addr = wire.decode(request)
+                try:
+                    msg, from_addr = wire.decode(request)
+                except wire.WireError:
+                    # dual-codec: a reference node speaks protobuf on the
+                    # same Protocol method names (protocol.proto:16-33) —
+                    # decode, convert to the native packet, reply protobuf
+                    return await self._pb_protocol(name, request, context)
                 return await method(msg, from_addr)
-            except wire.WireError as e:
+            except (wire.WireError, pw.WireError) as e:
                 await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
             except (TransportError, PermissionError, ValueError) as e:
                 await context.abort(grpc.StatusCode.FAILED_PRECONDITION,
                                     str(e))
         return handler
+
+    async def _pb_protocol(self, name: str, request: bytes, context):
+        """Protobuf branch of the Protocol plane: the wire layouts a
+        reference PEER sends (PartialBeacon, GetIdentity,
+        SignalDKGParticipant, PushDKGInfo, BroadcastDKG —
+        protocol.proto:16-33, dkg.proto:14-93). Responses are protobuf
+        (drand.Empty = b'' / drand.Identity). Ambiguity guard: proto3
+        parses near-arbitrary bytes into all-default messages, so each
+        decode requires its semantically-mandatory fields to be present
+        before the packet is accepted."""
+        peer = context.peer()
+        if name == "PartialBeacon":
+            req = pw.decode(pw.PARTIAL_BEACON_PACKET, request)
+            if not req["round"] or not req["partial_sig"]:
+                raise pw.WireError(
+                    "PartialBeacon decodes to default round/partial_sig")
+            await self._svc.process_partial_beacon(peer, PartialBeaconPacket(
+                round=req["round"], previous_sig=req["previous_sig"],
+                partial_sig=req["partial_sig"],
+                partial_sig_v2=req["partial_sig_v2"]))
+            return b""  # drand.Empty
+        if name == "GetIdentity":
+            if request:
+                raise pw.WireError("IdentityRequest carries no fields")
+            ident = await self._svc.get_identity(peer)
+            return pw.encode(pw.IDENTITY, {
+                "address": ident.addr, "key": ident.key.to_bytes(),
+                "tls": ident.tls, "signature": ident.signature})
+        if name == "SignalDKGParticipant":
+            req = pw.decode(pw.SIGNAL_DKG_PACKET, request)
+            if req["node"] is None or not req["secret_proof"]:
+                raise pw.WireError(
+                    "SignalDKGPacket without node/secret_proof")
+            from ..crypto.curves import PointG1
+            from ..key.keys import Identity
+            from .packets import SignalDKGPacket
+
+            nd = req["node"]
+            ident = Identity(key=PointG1.from_bytes(nd["key"]),
+                             addr=nd["address"], tls=nd["tls"],
+                             signature=nd["signature"])
+            await self._svc.signal_dkg_participant(peer, SignalDKGPacket(
+                identity=ident, secret=req["secret_proof"],
+                previous_group_hash=req["previous_group_hash"]))
+            return b""
+        if name == "PushDKGInfo":
+            req = pw.decode(pw.DKG_INFO_PACKET, request)
+            if req["new_group"] is None or not req["secret_proof"]:
+                raise pw.WireError(
+                    "DKGInfoPacket without new_group/secret_proof")
+            from .packets import GroupPacket as NativeGroupPacket
+
+            g = req["new_group"]
+            group_dict = {
+                "threshold": g["threshold"], "period": g["period"],
+                "catchup_period": g["catchup_period"],
+                "genesis_time": g["genesis_time"],
+                "transition_time": g["transition_time"],
+                "genesis_seed": g["genesis_seed"].hex(),
+                "nodes": [{
+                    "index": n["index"],
+                    "address": (n["public"] or {}).get("address", ""),
+                    "tls": (n["public"] or {}).get("tls", False),
+                    "key": (n["public"] or {}).get("key", b"").hex(),
+                    "signature":
+                        (n["public"] or {}).get("signature", b"").hex(),
+                } for n in g["nodes"]],
+            }
+            if g["dist_key"]:
+                group_dict["public_key"] = [c.hex() for c in g["dist_key"]]
+            await self._svc.push_dkg_info(peer, NativeGroupPacket(
+                group=group_dict, signature=req["signature"],
+                secret=req["secret_proof"],
+                dkg_timeout=float(req["dkg_timeout"] or 10.0)))
+            return b""
+        if name == "BroadcastDKG":
+            req = pw.decode(pw.DKG_PACKET, request)
+            if req["dkg"] is None:
+                raise pw.WireError("DKGPacket without dkg bundle")
+            arm, b = pw.oneof_of(req["dkg"], pw.DKG_BUNDLE_ARMS)
+            if arm is None:
+                raise pw.WireError("dkg.Packet with no bundle arm set")
+            from ..dkg import packets as dp
+
+            if arm == "deal":
+                bundle = dp.DealBundle(
+                    dealer_index=b["dealer_index"],
+                    commits=tuple(b["commits"]),
+                    deals=tuple(dp.Deal(share_index=d["share_index"],
+                                        encrypted_share=d["encrypted_share"])
+                                for d in b["deals"]),
+                    session_id=b["session_id"], signature=b["signature"])
+            elif arm == "response":
+                bundle = dp.ResponseBundle(
+                    share_index=b["share_index"],
+                    responses=tuple(dp.Response(
+                        dealer_index=r["dealer_index"],
+                        status=(dp.STATUS_APPROVAL if r["status"]
+                                else dp.STATUS_COMPLAINT))
+                        for r in b["responses"]),
+                    session_id=b["session_id"], signature=b["signature"])
+            else:
+                bundle = dp.JustificationBundle(
+                    dealer_index=b["dealer_index"],
+                    justifications=tuple(dp.Justification(
+                        share_index=j["share_index"], share=j["share"])
+                        for j in b["justifications"]),
+                    session_id=b["session_id"], signature=b["signature"])
+            await self._svc.broadcast_dkg(peer, bundle)
+            return b""
+        # no protobuf layout for this method: re-raise as a wire error
+        raise pw.WireError(f"method {name} has no protobuf request layout")
 
     async def _get_identity(self, msg, from_addr) -> bytes:
         ident = await self._svc.get_identity(from_addr)
@@ -179,10 +298,20 @@ class GrpcGateway:
         try:
             msg, from_addr = wire.decode(request)
         except wire.WireError:
-            from . import protowire as pw
-
             try:
+                if not request:
+                    # proto3 decodes b"" to an all-defaults message; an
+                    # empty request must not silently start a full chain
+                    # sync from round 0 (ADVICE r3)
+                    raise pw.WireError("empty SyncChain request")
                 req = pw.decode(pw.SYNC_REQUEST, request)
+                if not req.get("from_round"):
+                    # nearly-arbitrary bytes can proto3-parse to an
+                    # all-defaults message; a real reference node always
+                    # syncs from last-stored+1 >= 1 (protocol.proto:84-88)
+                    raise pw.WireError(
+                        "SyncChain request decodes to from_round=0 — "
+                        "rejecting ambiguous payload")
             except pw.WireError as e:
                 await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
                 return
@@ -192,8 +321,6 @@ class GrpcGateway:
         try:
             async for b in self._svc.sync_chain(from_addr, msg):
                 if proto:
-                    from . import protowire as pw
-
                     yield pw.encode(pw.BEACON_PACKET, {
                         "previous_sig": b.previous_sig, "round": b.round,
                         "signature": b.signature})
@@ -204,8 +331,6 @@ class GrpcGateway:
 
     # --------------------------------------------- drand.Public (protobuf)
     def _pb_beacon(self, b: Beacon) -> bytes:
-        from . import protowire as pw
-
         return pw.encode(pw.PUBLIC_RAND_RESPONSE, {
             "round": b.round, "signature": b.signature,
             "previous_signature": b.previous_sig,
@@ -213,8 +338,6 @@ class GrpcGateway:
             "signature_v2": b.signature_v2})
 
     async def _pb_public_rand(self, request: bytes, context) -> bytes:
-        from . import protowire as pw
-
         try:
             req = pw.decode(pw.PUBLIC_RAND_REQUEST, request)
             b = await self._svc.public_rand(context.peer(), req["round"])
@@ -232,8 +355,6 @@ class GrpcGateway:
             await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
 
     async def _pb_private_rand(self, request: bytes, context) -> bytes:
-        from . import protowire as pw
-
         try:
             req = pw.decode(pw.PRIVATE_RAND_REQUEST, request)
             out = await self._svc.private_rand(context.peer(),
@@ -245,8 +366,6 @@ class GrpcGateway:
             await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
 
     async def _pb_chain_info(self, request: bytes, context) -> bytes:
-        from . import protowire as pw
-
         try:
             info = await self._svc.chain_info(context.peer())
             return pw.encode(pw.CHAIN_INFO_PACKET, {
@@ -259,8 +378,6 @@ class GrpcGateway:
             await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
 
     async def _pb_home(self, request: bytes, context) -> bytes:
-        from . import protowire as pw
-
         return pw.encode(pw.HOME_RESPONSE,
                          {"status": "drand-tpu up and running"})
 

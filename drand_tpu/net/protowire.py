@@ -3,31 +3,46 @@
 The rest of the transport speaks this framework's own deterministic JSON
 envelope (net/wire.py); THIS module implements the reference's protobuf
 byte layouts so ecosystem drand clients can fetch, stream and sync from
-a drand-tpu node over the standard service/method names. Field numbers
-and types are transcribed from the reference wire spec (the protocol
-contract, not code):
+a drand-tpu node — and reference NODES can talk to us as a peer — over
+the standard service/method names. Field numbers and types are
+transcribed from the reference wire spec (the protocol contract, not
+code):
 
 - PublicRandRequest/Response, PrivateRand*, ChainInfoPacket, Home*:
   /root/reference/protobuf/drand/api.proto:36-80,
   /root/reference/protobuf/drand/common.proto:44-60
-- SyncRequest / BeaconPacket:
-  /root/reference/protobuf/drand/protocol.proto:84-92
+- SyncRequest / BeaconPacket / PartialBeaconPacket / SignalDKGPacket /
+  DKGInfoPacket / DKGPacket:
+  /root/reference/protobuf/drand/protocol.proto:16-92
+- Identity / Node / GroupPacket / Empty:
+  /root/reference/protobuf/drand/common.proto:10-43
+- DealBundle / ResponseBundle / JustificationBundle (+ inner Deal,
+  Response, Justification; oneof wrapper Packet):
+  /root/reference/protobuf/crypto/dkg/dkg.proto:14-93
 
-Hand-rolled minimal proto3 (varint + length-delimited only — every field
-in this surface is one of the two): no generated code, no protobuf
-runtime dependency. proto3 semantics honored: default-valued fields are
-omitted on encode, unknown fields are skipped on decode, last value wins
-for repeated scalar occurrences.
+Hand-rolled minimal proto3: no generated code, no protobuf runtime
+dependency. Field kinds: "u64"/"i64"/"u32" (plain varint), "bool",
+"bytes", "str", nested messages ``("msg", SPEC)`` and repeated fields
+``("rep", inner_kind)``. proto3 semantics honored: default-valued
+scalar fields are omitted on encode, unknown fields are skipped on
+decode, last value wins for non-repeated occurrences, repeated fields
+accumulate in order. oneof groups (dkg.Packet) are plain optional
+message fields — at most one is expected set; ``oneof_of`` returns the
+populated arm.
 """
 
 from __future__ import annotations
 
 __all__ = [
-    "encode", "decode", "WireError",
+    "encode", "decode", "WireError", "oneof_of",
     "PUBLIC_RAND_REQUEST", "PUBLIC_RAND_RESPONSE",
     "PRIVATE_RAND_REQUEST", "PRIVATE_RAND_RESPONSE",
     "CHAIN_INFO_REQUEST", "CHAIN_INFO_PACKET",
     "SYNC_REQUEST", "BEACON_PACKET", "HOME_REQUEST", "HOME_RESPONSE",
+    "EMPTY", "IDENTITY", "IDENTITY_REQUEST", "NODE", "GROUP_PACKET",
+    "PARTIAL_BEACON_PACKET", "SIGNAL_DKG_PACKET", "DKG_INFO_PACKET",
+    "DKG_PACKET", "DKG_BUNDLE", "DEAL", "DEAL_BUNDLE", "RESPONSE",
+    "RESPONSE_BUNDLE", "JUSTIFICATION", "JUSTIFICATION_BUNDLE",
 ]
 
 
@@ -78,33 +93,77 @@ _VARINT, _LEN = 0, 2
 # kinds: "u64" | "i64" (both plain varint on the wire), "bytes", "str"
 # ---------------------------------------------------------------------------
 
+_INT_KINDS = ("u64", "i64", "u32")
+
+
+def _encode_one(out: bytearray, num: int, kind, v,
+                keep_default: bool = False) -> None:
+    """``keep_default``: emit the field even when default-valued —
+    required inside repeated fields, where omitting an element would
+    silently shift every later element's position."""
+    if kind in _INT_KINDS:
+        v = int(v or 0)
+        if v == 0 and not keep_default:
+            return
+        _put_varint(out, (num << 3) | _VARINT)
+        _put_varint(out, v)
+    elif kind == "bool":
+        if not v and not keep_default:
+            return
+        _put_varint(out, (num << 3) | _VARINT)
+        _put_varint(out, 1 if v else 0)
+    elif kind in ("bytes", "str"):
+        if kind == "str":
+            v = (v or "").encode()
+        v = bytes(v or b"")
+        if not v and not keep_default:
+            return
+        _put_varint(out, (num << 3) | _LEN)
+        _put_varint(out, len(v))
+        out += v
+    elif isinstance(kind, tuple) and kind[0] == "msg":
+        if v is None:
+            if keep_default:
+                # a None element inside a repeated field would silently
+                # shift every later element's position
+                raise WireError(
+                    "None element in repeated message field")
+            return
+        body = encode(kind[1], v)
+        _put_varint(out, (num << 3) | _LEN)
+        _put_varint(out, len(body))
+        out += body
+    elif isinstance(kind, tuple) and kind[0] == "rep":
+        for item in (v or ()):
+            _encode_one(out, num, kind[1], item, keep_default=True)
+    else:  # pragma: no cover — spec authoring error
+        raise WireError(f"unknown field kind {kind!r}")
+
+
 def encode(spec: dict, values: dict) -> bytes:
     out = bytearray()
     for num in sorted(spec):
         name, kind = spec[num]
-        v = values.get(name)
-        if kind in ("u64", "i64"):
-            v = int(v or 0)
-            if v == 0:
-                continue
-            _put_varint(out, (num << 3) | _VARINT)
-            _put_varint(out, v)
-        else:
-            if kind == "str":
-                v = (v or "").encode()
-            v = bytes(v or b"")
-            if not v:
-                continue
-            _put_varint(out, (num << 3) | _LEN)
-            _put_varint(out, len(v))
-            out += v
+        _encode_one(out, num, kind, values.get(name))
     return bytes(out)
 
 
+def _default_for(kind):
+    if kind in _INT_KINDS:
+        return 0
+    if kind == "bool":
+        return False
+    if kind == "str":
+        return ""
+    if kind == "bytes":
+        return b""
+    if isinstance(kind, tuple) and kind[0] == "msg":
+        return None
+    return []  # repeated
+
+
 def decode(spec: dict, data: bytes) -> dict:
-    out = {name: ("" if kind == "str" else (0 if kind in ("u64", "i64")
-                                            else b""))
-           for name, kind in spec.values()}
+    out = {name: _default_for(kind) for name, kind in spec.values()}
     i = 0
     while i < len(data):
         tag, i = _get_varint(data, i)
@@ -131,17 +190,55 @@ def decode(spec: dict, data: bytes) -> dict:
         if field is None:
             continue  # unknown field: skip (proto3 forward compat)
         name, kind = field
-        if kind in ("u64", "i64"):
-            if not isinstance(v, int):
-                raise WireError(f"field {name}: wrong wire type")
-            if kind == "i64" and v >= 1 << 63:
+        repeated = isinstance(kind, tuple) and kind[0] == "rep"
+        inner = kind[1] if repeated else kind
+        if inner in _INT_KINDS or inner == "bool":
+            if repeated and wt == _LEN:
+                # packed repeated scalars (proto3's default encoding for
+                # repeated varints): consecutive varints in one payload
+                j, vals = 0, []
+                while j < len(v):
+                    pv, j = _get_varint(bytes(v), j)
+                    if inner == "i64" and pv >= 1 << 63:
+                        pv -= 1 << 64
+                    vals.append(bool(pv) if inner == "bool" else pv)
+                out[name].extend(vals)
+                continue
+            if wt != _VARINT:
+                raise WireError(f"field {name}: wrong wire type {wt}")
+            if inner == "i64" and v >= 1 << 63:
                 v -= 1 << 64
-            out[name] = v
+            val = bool(v) if inner == "bool" else v
         else:
-            if isinstance(v, int):
-                raise WireError(f"field {name}: wrong wire type")
-            out[name] = v.decode() if kind == "str" else bytes(v)
+            # everything length-delimited: a fixed64/fixed32 body must
+            # not silently become the field value (ADVICE r3)
+            if wt != _LEN:
+                raise WireError(f"field {name}: wrong wire type {wt}")
+            if inner == "str":
+                try:
+                    val = v.decode()
+                except UnicodeDecodeError as e:
+                    raise WireError(f"field {name}: invalid UTF-8") from e
+            elif inner == "bytes":
+                val = bytes(v)
+            elif isinstance(inner, tuple) and inner[0] == "msg":
+                val = decode(inner[1], bytes(v))
+            else:  # pragma: no cover — spec authoring error
+                raise WireError(f"unknown field kind {inner!r}")
+        if repeated:
+            out[name].append(val)
+        else:
+            out[name] = val
     return out
+
+
+def oneof_of(decoded: dict, arms: tuple[str, ...]):
+    """(arm_name, value) for the single populated oneof arm, or
+    (None, None); raises WireError if several arms are set."""
+    hit = [(a, decoded[a]) for a in arms if decoded.get(a) is not None]
+    if len(hit) > 1:
+        raise WireError(f"oneof with multiple arms set: {[a for a, _ in hit]}")
+    return hit[0] if hit else (None, None)
 
 
 # ---------------------------------------------------------------------------
@@ -174,3 +271,89 @@ BEACON_PACKET = {
 }
 HOME_REQUEST: dict = {}
 HOME_RESPONSE = {1: ("status", "str")}
+
+# --- protocol plane (protocol.proto:16-92, common.proto:10-43) -------------
+
+EMPTY: dict = {}
+IDENTITY_REQUEST: dict = {}
+IDENTITY = {
+    1: ("address", "str"),
+    2: ("key", "bytes"),
+    3: ("tls", "bool"),
+    4: ("signature", "bytes"),
+}
+NODE = {
+    1: ("public", ("msg", IDENTITY)),
+    2: ("index", "u32"),
+}
+GROUP_PACKET = {
+    1: ("nodes", ("rep", ("msg", NODE))),
+    2: ("threshold", "u32"),
+    3: ("period", "u32"),            # seconds
+    4: ("genesis_time", "u64"),
+    5: ("transition_time", "u64"),
+    6: ("genesis_seed", "bytes"),
+    7: ("dist_key", ("rep", "bytes")),
+    8: ("catchup_period", "u32"),    # seconds
+}
+PARTIAL_BEACON_PACKET = {
+    1: ("round", "u64"),
+    2: ("previous_sig", "bytes"),
+    3: ("partial_sig", "bytes"),
+    4: ("partial_sig_v2", "bytes"),
+}
+SIGNAL_DKG_PACKET = {
+    1: ("node", ("msg", IDENTITY)),
+    2: ("secret_proof", "bytes"),
+    3: ("previous_group_hash", "bytes"),
+}
+DKG_INFO_PACKET = {
+    1: ("new_group", ("msg", GROUP_PACKET)),
+    2: ("secret_proof", "bytes"),
+    3: ("dkg_timeout", "u32"),
+    4: ("signature", "bytes"),
+}
+
+# --- DKG broadcast bundles (dkg.proto:14-93) -------------------------------
+
+DEAL = {
+    1: ("share_index", "u32"),
+    2: ("encrypted_share", "bytes"),
+}
+DEAL_BUNDLE = {
+    1: ("dealer_index", "u32"),
+    2: ("commits", ("rep", "bytes")),
+    3: ("deals", ("rep", ("msg", DEAL))),
+    4: ("session_id", "bytes"),
+    5: ("signature", "bytes"),
+}
+RESPONSE = {
+    1: ("dealer_index", "u32"),
+    2: ("status", "bool"),
+}
+RESPONSE_BUNDLE = {
+    1: ("share_index", "u32"),
+    2: ("responses", ("rep", ("msg", RESPONSE))),
+    3: ("session_id", "bytes"),
+    4: ("signature", "bytes"),
+}
+JUSTIFICATION = {
+    1: ("share_index", "u32"),
+    2: ("share", "bytes"),
+}
+JUSTIFICATION_BUNDLE = {
+    1: ("dealer_index", "u32"),
+    2: ("justifications", ("rep", ("msg", JUSTIFICATION))),
+    3: ("session_id", "bytes"),
+    4: ("signature", "bytes"),
+}
+# dkg.Packet: oneof {deal, response, justification} — three optional
+# message fields; oneof_of() recovers the populated arm
+DKG_BUNDLE = {
+    1: ("deal", ("msg", DEAL_BUNDLE)),
+    2: ("response", ("msg", RESPONSE_BUNDLE)),
+    3: ("justification", ("msg", JUSTIFICATION_BUNDLE)),
+}
+DKG_BUNDLE_ARMS = ("deal", "response", "justification")
+# protocol.proto DKGPacket { dkg.Packet dkg = 1; }
+DKG_PACKET = {1: ("dkg", ("msg", DKG_BUNDLE))}

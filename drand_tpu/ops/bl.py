@@ -44,13 +44,19 @@ NLIMBS = _x.NLIMBS
 MASK = _x.MASK
 DTYPE = _x.DTYPE
 
-# conv strategy: "unroll" = 32 static shifted partial products (parallel,
-# bigger trace), "loop" = fori_loop accumulation (compact trace, serial).
+# conv strategy:
+#   "tree"   = product rows + log-tree aligned accumulation (default —
+#              same values as "unroll" by pure reassociation, but ~half
+#              the lane-multiplies: no zero-padded window mults, and the
+#              accumulation adds shrink from n_rows*out_len to a
+#              ~1.1*out_len log-tree)
+#   "unroll" = 32 static shifted out_len-wide partial products
+#   "loop"   = fori_loop accumulation (compact trace, serial)
 # TRACE-TIME constant: it is read when a kernel first compiles and is NOT
 # part of any jit cache key — set it before the first compile (e.g. in a
 # test's setup) and never flip it mid-process; a flip after compilation is
-# silently ignored for already-jitted callers. Tests cover both modes.
-CONV_MODE = "unroll"
+# silently ignored for already-jitted callers. Tests cover all modes.
+CONV_MODE = __import__("os").environ.get("DRAND_TPU_CONV", "tree")
 
 
 # ---------------------------------------------------------------------------
@@ -294,6 +300,42 @@ def _conv_unrolled(a, b, out_len: int):
     return jnp.sum(jnp.stack(terms, axis=0), axis=0, dtype=DTYPE)
 
 
+def _conv_tree(a, b, out_len: int):
+    """Product rows + log-tree aligned accumulation.
+
+    Row i is the UNPADDED product a_i * b (32 limbs, value offset i);
+    rows then combine pairwise — each combine concatenates one zero
+    block of the offset delta and adds, so row lengths grow 32 -> 33 ->
+    35 -> 39 -> 47 -> 63 instead of every row being an out_len-wide
+    window. Versus _conv_unrolled this executes exactly the n*m true
+    limb products (the windowed form multiplies ~50% zeros at
+    out_len=2n) and ~out_len*log(n) accumulation adds instead of
+    out_len*n. Values are bit-identical (pure reassociation of the same
+    non-negative int32 sums — the 2^29 coefficient bound of the
+    schoolbook form is unchanged). Mosaic-safe: static slices, concats
+    and elementwise ops only."""
+    n = a.shape[-2]
+    rows = [a[..., i:i + 1, :] * b for i in range(n)]  # value offset = i
+    d = 1
+    while len(rows) > 1:
+        assert len(rows) % 2 == 0, "power-of-two limb count expected"
+        nxt = []
+        for j in range(0, len(rows), 2):
+            x, y = rows[j], rows[j + 1]  # offsets j*d, (j+1)*d
+            z = jnp.zeros_like(x[..., :d, :])
+            nxt.append(jnp.concatenate([x, z], axis=-2)
+                       + jnp.concatenate([z, y], axis=-2))
+        rows = nxt
+        d *= 2
+    out = rows[0]
+    got = out.shape[-2]
+    if got < out_len:
+        z = jnp.zeros(out.shape[:-2] + (out_len - got, out.shape[-1]),
+                      out.dtype)
+        return jnp.concatenate([out, z], axis=-2)
+    return out[..., :out_len, :]
+
+
 def _conv_looped(a, b, out_len: int):
     """Same convolution as a fori_loop (compact trace for huge kernels)."""
     z = jnp.zeros_like(b)
@@ -310,9 +352,15 @@ def _conv_looped(a, b, out_len: int):
 
 
 def _conv(a, b, out_len: int):
+    if CONV_MODE == "tree":
+        return _conv_tree(a, b, out_len)
     if CONV_MODE == "unroll":
         return _conv_unrolled(a, b, out_len)
-    return _conv_looped(a, b, out_len)
+    if CONV_MODE == "loop":
+        return _conv_looped(a, b, out_len)
+    raise ValueError(
+        f"unknown DRAND_TPU_CONV mode {CONV_MODE!r} "
+        f"(expected tree|unroll|loop)")
 
 
 def mont_mul(a, b):

@@ -172,6 +172,8 @@ class BatchedEngine:
         self._wire_ok: dict[int, bool] = {}
         self._eval_ok: dict[tuple[int, int], bool] = {}
         self._poly_eval_ok: dict[tuple[int, int], bool] = {}
+        self._agg_ok: dict[tuple[int, int], bool] = {}
+        self._agg_graph_jit = jax.jit(self._agg_graph)
 
     @staticmethod
     def _wire_graph(pub_aff, sig_x, sig_sign, u_pairs):
@@ -493,30 +495,14 @@ class BatchedEngine:
         over the commitment polynomial (the host loop costs ~10 point
         ops per coefficient per index — seconds at 67-of-100 scale)."""
         msg_pt = self._hash_msg(msg, dst)
-        idxs = sorted({tbls.index_of(p) for p in partials
-                       if len(p) == tbls.PARTIAL_SIG_SIZE})
-        # out-of-ladder-range indices (garbage partials) fall back to the
-        # per-index host eval below rather than aborting the device batch
-        # for everyone — their signatures fail verification regardless
-        need = [i for i in idxs if i not in pub_poly._eval_cache
-                and 0 <= i + 1 < (1 << _EVAL_IDX_BITS)]
-        if need:
-            try:
-                evals = self.eval_poly_indices(pub_poly, need)
-                from ..crypto.poly import PubShare
-
-                for i, v in zip(need, evals):
-                    pub_poly._eval_cache[i] = PubShare(i, v)
-            except Exception:  # noqa: BLE001 — host oracle fallback
-                pass  # pub_poly.eval below computes host-side
+        pubkeys = self._share_pubkeys(pub_poly, partials)
         triples = []
-        for p in partials:
-            if len(p) != tbls.PARTIAL_SIG_SIZE:
+        for p, pk in zip(partials, pubkeys):
+            if pk is None:
                 triples.append((PointG1.generator(), None, msg_pt))
-                continue
-            idx = tbls.index_of(p)
-            triples.append((pub_poly.eval(idx).value,
-                            _decode_sig(p[tbls.INDEX_BYTES:]), msg_pt))
+            else:
+                triples.append((pk, _decode_sig(p[tbls.INDEX_BYTES:]),
+                                msg_pt))
         return [bool(v) for v in self.verify_bls(triples)]
 
     def eval_poly_indices(self, pub_poly: PubPoly,
@@ -623,15 +609,23 @@ class BatchedEngine:
         if b is None:
             raise RuntimeError(
                 "device engine: no eval bucket passed validation")
-        # async chunk dispatch, one tail drain (see verify_bls)
+        # async chunk dispatch; pack every chunk's (x, y, inf) into one
+        # device-side int32 block and pull ALL chunks with ONE host
+        # transfer (ADVICE r3: per-chunk np.asarray×3 paid 3×chunks
+        # ~100 ms tunnel polling floors — same discipline as _drain)
         launches = [self._launch_eval_bucket(polys[i:i + b], index, b)
                     for i in range(0, n, b)]
-        for dev, _ in reversed(launches):
-            dev[0].block_until_ready()
-            break
+        packed = jnp.concatenate(
+            [jnp.concatenate(
+                [ax, ay, inf[:, None].astype(jnp.int32)], axis=1)
+             for (ax, ay, inf), _ in launches], axis=0)
+        host = np.asarray(packed)
         out = []
-        for dev, cnt in launches:
-            out.extend(self._unpack_eval(dev, cnt))
+        for chunk, (_, cnt) in zip(range(0, len(launches) * b, b), launches):
+            rows = host[chunk:chunk + b]
+            out.extend(self._unpack_eval_rows(
+                rows[:, :limb.NLIMBS], rows[:, limb.NLIMBS:2 * limb.NLIMBS],
+                rows[:, -1].astype(bool), cnt))
         return out
 
     def _run_eval_bucket(self, polys, index: int, b: int) -> list[PointG1]:
@@ -660,9 +654,13 @@ class BatchedEngine:
 
     @staticmethod
     def _unpack_eval(dev, n: int) -> list[PointG1]:
+        ax, ay, inf = (np.asarray(c) for c in dev)
+        return BatchedEngine._unpack_eval_rows(ax, ay, inf, n)
+
+    @staticmethod
+    def _unpack_eval_rows(ax, ay, inf, n: int) -> list[PointG1]:
         from ..crypto.fields import Fp
 
-        ax, ay, inf = (np.asarray(c) for c in dev)
         out = []
         for d in range(n):
             if inf[d]:
@@ -706,12 +704,10 @@ class BatchedEngine:
         return ok
 
     # ------------------------------------------------------------ recover
-    def recover(self, pub_poly: PubPoly, msg: bytes, partials, t: int, n: int,
-                dst: bytes = DEFAULT_DST_G2) -> bytes:
-        """Lagrange-recover the full signature on device: one G2 MSM with
-        the Lagrange coefficients as scalars (Scheme.Recover,
-        chain/beacon/chain.go:136). Same selection semantics as the host
-        tbls.recover: first t distinct valid indices win."""
+    @staticmethod
+    def _select_shares(partials, t: int, n: int) -> list[PubShare]:
+        """First t distinct well-formed indices win — the tbls.recover
+        selection semantics, shared by recover and the fused round."""
         shares: list[PubShare] = []
         seen: set[int] = set()
         for p in partials:
@@ -727,10 +723,25 @@ class BatchedEngine:
             shares.append(PubShare(idx, pt))
             if len(shares) == t:
                 break
+        return shares
+
+    def recover(self, pub_poly: PubPoly, msg: bytes, partials, t: int, n: int,
+                dst: bytes = DEFAULT_DST_G2) -> bytes:
+        """Lagrange-recover the full signature on device: one G2 MSM with
+        the Lagrange coefficients as scalars (Scheme.Recover,
+        chain/beacon/chain.go:136). Same selection semantics as the host
+        tbls.recover: first t distinct valid indices win."""
+        shares = self._select_shares(partials, t, n)
         if len(shares) < t:
             raise ValueError(f"not enough valid partials: {len(shares)} < {t}")
         lambdas = lagrange_coefficients([s.index for s in shares])
         b = _bucket(t, self.buckets)
+        use_lanes = jax.default_backend() == "tpu" and b > self.PIPPENGER_MIN_T
+        if use_lanes and b & (b - 1):
+            # msm_lanes' log-tree fold needs power-of-two lanes; a custom
+            # BatchedEngine(buckets=...) may hand us any size — pad up,
+            # the extra rows are masked infinity (ADVICE r3)
+            b = 1 << (b - 1).bit_length()
         pad = _g2_aff(PointG2.generator())
         pts_np = np.broadcast_to(pad, (b, 2, 2, limb.NLIMBS)).copy()
         inf = np.ones(b, dtype=bool)  # padding rows masked out as infinity
@@ -743,7 +754,7 @@ class BatchedEngine:
         z_one[:, 0] = np.asarray(limb.ONE_MONT)
         pts = (jnp.asarray(pts_np[:, 0]), jnp.asarray(pts_np[:, 1]),
                jnp.asarray(z_one), jnp.asarray(inf))
-        if jax.default_backend() == "tpu" and b > self.PIPPENGER_MIN_T:
+        if use_lanes:
             # per-lane ladders + log-tree fold (msm_lanes): the unrolled
             # ladder/window graphs take >10 min to COMPILE at b=128 on
             # the XLA limb path, and a fully-sequential scan is
@@ -763,6 +774,235 @@ class BatchedEngine:
             Fp2.one(),
         )
         return rec.to_bytes()
+
+    # ------------------------------------------- fused aggregator round
+    @staticmethod
+    def _agg_graph(pubs, sigs, msgs, slot_mask, mx, my, mz, minf, mbits):
+        """The aggregator's whole per-round crypto as ONE device graph
+        (chain/beacon/chain.go:91-166 in a single dispatch): Lagrange MSM
+        over the chosen partials, recovered signature spliced into the
+        pairing batch at the ``slot_mask`` row, every partial AND the
+        recovered signature verified together. Output is one flat int32
+        vector so the host pays a single transfer:
+        [ok (b,), rec_x (2*NLIMBS), rec_y (2*NLIMBS), rec_inf (1)]."""
+        b = pubs.shape[0]
+        rx, ry, rinf = curve.pt_to_affine(
+            curve.F2, curve.msm_lanes(curve.F2, (mx, my, mz, minf), mbits))
+        rec_row = jnp.stack([rx, ry])                      # (2, 2, NLIMBS)
+        sig_full = jnp.where(slot_mask[:, None, None, None],
+                             rec_row[None], sigs)
+        if jax.default_backend() == "tpu" and b >= PALLAS_MIN_BUCKET:
+            from . import pallas_pairing as pp
+
+            xp, yp, q = pp.pack_verify_inputs(pubs, sig_full, msgs)
+            if b % pp.GRID_BLOCK == 0:
+                ok = pp._verify_pl_grid(xp, yp, q, npairs=2, b=b)
+            else:
+                ok = pp._verify_pl(xp, yp, q, npairs=2, b=b)
+        else:
+            ok = pairing.verify_prepared(pubs, sig_full, msgs)
+        ok = ok & (~slot_mask | ~rinf)
+        return jnp.concatenate([
+            ok.astype(jnp.int32), rx.reshape(-1), ry.reshape(-1),
+            rinf.reshape(1).astype(jnp.int32)])
+
+    def _share_pubkeys(self, pub_poly: PubPoly, partials):
+        """Per-partial share public keys via ONE batched device Horner
+        (eval_poly_indices), cache-backed; None for malformed partials."""
+        idxs = sorted({tbls.index_of(p) for p in partials
+                       if len(p) == tbls.PARTIAL_SIG_SIZE})
+        need = [i for i in idxs if i not in pub_poly._eval_cache
+                and 0 <= i + 1 < (1 << _EVAL_IDX_BITS)]
+        if need:
+            try:
+                evals = self.eval_poly_indices(pub_poly, need)
+                from ..crypto.poly import PubShare
+
+                for i, v in zip(need, evals):
+                    pub_poly._eval_cache[i] = PubShare(i, v)
+            except Exception:  # noqa: BLE001 — host oracle fallback
+                pass  # pub_poly.eval below computes host-side
+        out = []
+        for p in partials:
+            if len(p) != tbls.PARTIAL_SIG_SIZE:
+                out.append(None)
+            else:
+                out.append(pub_poly.eval(tbls.index_of(p)).value)
+        return out
+
+    def _check_agg_bucket(self, b: int, b_msm: int) -> bool:
+        """KAT-gate the fused executable per (bucket, msm-lane) shape —
+        same axon-miscompile discipline as every other graph family: a
+        toy 2-of-3 group whose recovery and verdicts are known on host."""
+        key = (b, b_msm)
+        ok = self._agg_ok.get(key)
+        if ok is not None:
+            return ok
+        from ..crypto.poly import PriPoly
+
+        try:
+            poly = PriPoly.random(2, seed=b"engine-agg-kat")
+            pub_poly = poly.commit()
+            msg = b"engine-agg-bucket-check"
+            parts = [tbls.sign_partial(s, msg) for s in poly.shares(3)]
+            bad = parts[2][:tbls.INDEX_BYTES] + parts[1][tbls.INDEX_BYTES:]
+            expect_sig = tbls.recover(pub_poly, msg, parts[:2], 2, 3)
+            oks, rec = self._run_agg(pub_poly, msg, parts[:2] + [bad],
+                                     2, 3, DEFAULT_DST_G2, b, b_msm)
+            ok = (oks == [True, True, False] and rec == expect_sig)
+        except Exception:  # noqa: BLE001 — trace/lowering failures too
+            ok = False
+        self._agg_ok[key] = ok
+        if not ok:
+            from ..utils.logging import default_logger
+
+            default_logger("engine").warn(
+                "engine", "agg_bucket_disabled", bucket=b, msm_lanes=b_msm)
+        return ok
+
+    def aggregate_round(self, pub_poly: PubPoly, msg: bytes, partials,
+                        t: int, n: int,
+                        dst: bytes = DEFAULT_DST_G2):
+        """Verify all partials + Lagrange-recover + verify the recovered
+        signature in ONE device dispatch with one result transfer — the
+        aggregator's per-round work (chain/beacon/chain.go:91-166) that
+        previously took 3+ synced calls, each paying the ~100 ms tunnel
+        polling floor.
+
+        Returns ``(oks, sig_bytes)`` with ``oks`` aligned to ``partials``.
+        Optimistic: recovery uses the first ``t`` well-formed distinct
+        indices (tbls.recover selection); if one of those turns out
+        invalid — or the recovered signature fails — falls back to the
+        classic verify→filter→recover→verify path. Raises ``ValueError``
+        when fewer than ``t`` well-formed partials exist."""
+        npart = len(partials)
+        shares = self._select_shares(partials, t, n)
+        if len(shares) < t:
+            raise ValueError(f"not enough valid partials: {len(shares)} < {t}")
+        b, b_msm = self.agg_shape(npart, t)
+        if npart + 1 > b or not self._check_agg_bucket(b, b_msm):
+            oks = self.verify_partials(pub_poly, msg, partials, dst)
+            return oks, self._recover_verified(pub_poly, msg, partials, oks,
+                                               t, n, dst)
+        oks, rec = self._run_agg(pub_poly, msg, partials, t, n, dst,
+                                 b, b_msm, shares=shares)
+        chosen = {s.index for s in shares}
+        chosen_ok = all(
+            ok for p, ok in zip(partials, oks)
+            if len(p) == tbls.PARTIAL_SIG_SIZE
+            and tbls.index_of(p) in chosen)
+        if rec is not None and chosen_ok:
+            return oks, rec
+        # a chosen partial was invalid (or the recovery failed): recover
+        # from the verified survivors instead
+        return oks, self._recover_verified(pub_poly, msg, partials, oks,
+                                           t, n, dst)
+
+    def agg_shape(self, npart: int, t: int) -> tuple[int, int]:
+        """(pairing bucket, msm lanes) the fused round would use — the
+        KAT cache key shape."""
+        return (_bucket(npart + 1, self.buckets),
+                max(8, 1 << (t - 1).bit_length()))
+
+    def agg_fused_active(self, npart: int, t: int) -> bool:
+        """True iff an (npart, t) aggregate_round runs the single-dispatch
+        fused executable (its KAT passed) rather than the fallback —
+        callers (bench.py) report this without reaching into the KAT
+        cache internals."""
+        return bool(self._agg_ok.get(self.agg_shape(npart, t)))
+
+    def _recover_verified(self, pub_poly, msg, partials, oks, t, n, dst):
+        """Classic tail: recover from the partials that verified, then
+        cryptographically check the recovered signature."""
+        good = [p for p, ok in zip(partials, oks) if ok]
+        if len(good) < t:
+            raise ValueError(
+                f"not enough valid partials: {len(good)} < {t}")
+        sig = self.recover(pub_poly, msg, good, t, n, dst)
+        if self.verify_sigs(pub_poly.commit(), [(msg, sig)], dst) != [True]:
+            raise tbls.RecoveredSignatureInvalid(
+                "recovered signature failed verification")
+        return sig
+
+    def _run_agg(self, pub_poly, msg, partials, t, n, dst, b, b_msm,
+                 shares=None):
+        """Pack, dispatch and unpack one fused round; returns (oks, sig
+        bytes | None-if-recovered-infinity)."""
+        npart = len(partials)
+        msg_pt = self._hash_msg(msg, dst)
+        pubkeys = self._share_pubkeys(pub_poly, partials)
+        if shares is None:
+            shares = self._select_shares(partials, t, n)
+        lambdas = lagrange_coefficients([s.index for s in shares])
+
+        # pairing batch: rows 0..npart-1 = partials, row npart = recovered
+        pubs = np.zeros((b, 2, limb.NLIMBS), np.int32)
+        sigs = np.zeros((b, 2, 2, limb.NLIMBS), np.int32)
+        msgs = np.zeros((b, 2, 2, limb.NLIMBS), np.int32)
+        valid = np.zeros(b, dtype=bool)
+        pad_pub, pad_g2 = (_g1_aff(PointG1.generator()),
+                           _g2_aff(PointG2.generator()))
+        pubs[:], sigs[:], msgs[:] = pad_pub, pad_g2, pad_g2
+        rows, g1s, g2s = [], [], []
+        for i, (p, pk) in enumerate(zip(partials, pubkeys)):
+            if pk is None or pk.is_infinity():
+                continue
+            pt = _decode_sig(p[tbls.INDEX_BYTES:])
+            if pt is None or pt.is_infinity():
+                continue
+            rows.append(i)
+            g1s.append(pk)
+            g2s.append(pt)
+        slot = npart
+        group_key = pub_poly.commit()
+        g1s.append(group_key)
+        g2s.append(msg_pt)  # recovered row checks against H(msg) too
+        g1_xy = PointG1.batch_to_affine(g1s)
+        g2_xy = PointG2.batch_to_affine(g2s)
+        msg_aff = _g2_xy(g2_xy[-1])
+        for j, i in enumerate(rows):
+            pubs[i] = _g1_xy(g1_xy[j])
+            sigs[i] = _g2_xy(g2_xy[j])
+            msgs[i] = msg_aff
+            valid[i] = True
+        pubs[slot] = _g1_xy(g1_xy[-1])
+        msgs[slot] = msg_aff
+        slot_mask = np.zeros(b, dtype=bool)
+        slot_mask[slot] = True
+
+        # MSM lanes (same packing as recover(), b_msm power-of-two)
+        pad = _g2_aff(PointG2.generator())
+        pts_np = np.broadcast_to(pad, (b_msm, 2, 2, limb.NLIMBS)).copy()
+        inf = np.ones(b_msm, dtype=bool)
+        bits = np.zeros((b_msm, 255), np.int32)
+        share_xy = PointG2.batch_to_affine([s.value for s in shares])
+        for i, s in enumerate(shares):
+            pts_np[i] = _g2_xy(share_xy[i])
+            inf[i] = False
+            bits[i] = curve.scalar_to_bits(lambdas[s.index] % R, 255)
+        z_one = np.zeros((b_msm, 2, limb.NLIMBS), np.int32)
+        z_one[:, 0] = np.asarray(limb.ONE_MONT)
+
+        flat = np.asarray(self._agg_graph_jit(
+            jnp.asarray(pubs), jnp.asarray(sigs), jnp.asarray(msgs),
+            jnp.asarray(slot_mask), jnp.asarray(pts_np[:, 0]),
+            jnp.asarray(pts_np[:, 1]), jnp.asarray(z_one),
+            jnp.asarray(inf), jnp.asarray(bits)))
+        ok = flat[:b].astype(bool) & valid
+        L = limb.NLIMBS
+        rx = flat[b:b + 2 * L].reshape(2, L)
+        ry = flat[b + 2 * L:b + 4 * L].reshape(2, L)
+        rinf = bool(flat[-1])
+        oks = [bool(v) for v in ok[:npart]]
+        if rinf or not flat[slot]:
+            return oks, None
+        from ..crypto.fields import Fp2
+
+        rec = PointG2(
+            Fp2(limb.fp_from_device(rx[0]), limb.fp_from_device(rx[1])),
+            Fp2(limb.fp_from_device(ry[0]), limb.fp_from_device(ry[1])),
+            Fp2.one())
+        return oks, rec.to_bytes()
 
 
 # index width for the eval_commits ladder (node indices are tiny; 10 bits

@@ -1,0 +1,83 @@
+"""Backend-init watchdog (utils/backend.py).
+
+Round-3 regression class: the axon tunnel going down turned the driver's
+official record red (BENCH_r03 rc=1 unparseable traceback, MULTICHIP_r03
+rc=124 infinite hang). Every entry point now goes through
+``init_backend``, which must (a) succeed when a backend is available,
+(b) raise ``BackendUnavailable`` within the deadline on fast repeated
+failures, and (c) force-exit with the caller's exit code + diagnostic
+when the init call hangs in C (only a watchdog thread can escape that).
+
+The reference has no analogue (a Go binary has no remote device to
+lose); this is axon-environment hardening.
+"""
+
+import subprocess
+import sys
+import time
+import types
+
+import pytest
+
+from drand_tpu.utils import backend as B
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+
+
+def test_init_backend_success():
+    platform, devs = B.init_backend(deadline=120)
+    assert devs, "no devices from a live backend"
+    assert platform in ("cpu", "tpu", "axon")
+
+
+def test_fast_failure_raises_within_deadline(monkeypatch):
+    calls = []
+
+    fake = types.ModuleType("jax")
+
+    def _devices():
+        calls.append(time.monotonic())
+        raise RuntimeError("Unable to initialize backend 'axon': UNAVAILABLE")
+
+    fake.devices = _devices
+    fake.default_backend = lambda: "axon"
+    monkeypatch.setitem(sys.modules, "jax", fake)
+    monkeypatch.delenv("DRAND_TPU_BACKEND_DEADLINE", raising=False)
+
+    failures = []
+    t0 = time.monotonic()
+    with pytest.raises(B.BackendUnavailable, match="UNAVAILABLE"):
+        B.init_backend(deadline=2.0, retry_interval=0.3,
+                       on_fail=failures.append)
+    dt = time.monotonic() - t0
+    assert len(calls) >= 3, "did not retry fast failures"
+    assert dt < 10, f"gave up too slowly ({dt:.1f}s for a 2s deadline)"
+    assert failures and "unavailable" in failures[0]
+
+
+def test_hang_force_exits_with_diagnostic():
+    """A hanging backend init must not outlive the watchdog: the process
+    exits with the requested code after running on_fail (bench.py uses
+    this to emit its structured final JSON line)."""
+    script = f"""
+import sys, time, types
+fake = types.ModuleType("jax")
+fake.devices = lambda: time.sleep(3600)   # hang "in init"
+fake.default_backend = lambda: "axon"
+sys.modules["jax"] = fake
+sys.path.insert(0, {REPO!r})
+from drand_tpu.utils.backend import init_backend
+init_backend(deadline=1.0, retry_interval=0.5,
+             on_fail=lambda r: print("FINAL-LINE " + r, flush=True),
+             exit_code=7)
+print("UNREACHABLE", flush=True)
+"""
+    env = {"PATH": "/usr/bin:/bin", "PYTHONPATH": REPO}
+    t0 = time.monotonic()
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=60)
+    dt = time.monotonic() - t0
+    assert proc.returncode == 7, (proc.returncode, proc.stderr)
+    assert "FINAL-LINE" in proc.stdout
+    assert "UNREACHABLE" not in proc.stdout
+    assert dt < 30, f"watchdog too slow: {dt:.1f}s"

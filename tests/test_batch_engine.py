@@ -102,6 +102,38 @@ class TestEngineVsHost:
         with pytest.raises(ValueError):
             engine.recover(pub, msg, partials, 2, 3)
 
+    def test_aggregate_round_fused(self, engine, threshold_setup):
+        _, pub, shares, _, _ = threshold_setup
+        msg = b"round-agg"
+        partials = [tbls.sign_partial(s, msg) for s in shares]
+        oks, sig = engine.aggregate_round(pub, msg, partials, 2, 3)
+        assert oks == [True] * 3
+        assert sig == tbls.recover(pub, msg, partials, 2, 3)
+        # the fused executable (bucket 4, 8 msm lanes) must have passed
+        # its KAT — i.e. this went through ONE dispatch, not the fallback
+        assert engine.agg_shape(3, 2) == (4, 8)
+        assert engine._agg_ok.get((4, 8)) is True
+
+    def test_aggregate_round_bad_chosen_partial(self, engine,
+                                                threshold_setup):
+        # a corrupt partial inside the optimistic t-subset: flagged in
+        # oks, and recovery re-runs over the verified survivors
+        _, pub, shares, _, _ = threshold_setup
+        msg = b"round-agg-bad"
+        partials = [tbls.sign_partial(s, msg) for s in shares]
+        bad = partials[0][:5] + bytes([partials[0][5] ^ 1]) + partials[0][6:]
+        oks, sig = engine.aggregate_round(
+            pub, msg, [bad, partials[1], partials[2]], 2, 3)
+        assert oks == [False, True, True]
+        assert sig == tbls.recover(pub, msg, partials[1:], 2, 3)
+
+    def test_aggregate_round_not_enough(self, engine, threshold_setup):
+        _, pub, shares, _, _ = threshold_setup
+        msg = b"round-agg-short"
+        with pytest.raises(ValueError):
+            engine.aggregate_round(
+                pub, msg, [tbls.sign_partial(shares[0], msg)], 2, 3)
+
     def test_verify_beacons_dual(self, engine, threshold_setup):
         *_, sk, pubkey = threshold_setup
         beacons = _make_chain(sk, 3)
@@ -129,6 +161,21 @@ class TestBatchDispatch:
         b.configure("host")
         host = batch.verify_beacons(pubkey, beacons)
         assert list(dev) == list(host) == [True, True, True]
+
+    def test_aggregate_round_host_path(self, threshold_setup):
+        import drand_tpu.crypto.batch as b
+
+        _, pub, shares, *_ = threshold_setup
+        msg = b"agg-host"
+        partials = [tbls.sign_partial(s, msg) for s in shares]
+        old = (b._MODE, b._MIN_BATCH, b._ENGINE)
+        b.configure("host")
+        try:
+            oks, sig = batch.aggregate_round(pub, msg, partials, 2, 3)
+        finally:
+            b._MODE, b._MIN_BATCH, b._ENGINE = old
+        assert oks == [True] * 3
+        assert sig == tbls.recover(pub, msg, partials, 2, 3)
 
     def test_verify_recovered_many(self, threshold_setup, device_mode):
         _, pub, shares, sk, pubkey = threshold_setup

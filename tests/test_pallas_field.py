@@ -90,14 +90,27 @@ def test_conv_modes_agree():
     xs, ys = rand_fp_ints(), rand_fp_ints()
     a, b = jnp.asarray(bl.pack_fp(xs)), jnp.asarray(bl.pack_fp(ys))
     prev = bl.CONV_MODE
+    outs = {}
     try:
-        bl.CONV_MODE = "unroll"
-        out_u = np.asarray(bl.mont_mul(a, b))
-        bl.CONV_MODE = "loop"
-        out_l = np.asarray(bl.mont_mul(a, b))
+        for mode in ("unroll", "loop", "tree"):
+            bl.CONV_MODE = mode
+            outs[mode] = bl.unpack_fp(np.asarray(bl.mont_mul(a, b)))
     finally:
         bl.CONV_MODE = prev
-    assert bl.unpack_fp(out_u) == bl.unpack_fp(out_l)
+    assert outs["unroll"] == outs["loop"] == outs["tree"]
+
+
+def test_conv_tree_bit_identical_raw():
+    # the tree form must be a pure reassociation: identical RAW limb
+    # coefficients (not just values) to the windowed schoolbook form,
+    # for both the 64-limb product and the 32-limb low-half conv
+    rng = np.random.default_rng(7)
+    a = jnp.asarray(rng.integers(0, 1 << 12, (bl.NLIMBS, 4), dtype=np.int32))
+    b = jnp.asarray(rng.integers(0, 1 << 12, (bl.NLIMBS, 4), dtype=np.int32))
+    for out_len in (2 * bl.NLIMBS, bl.NLIMBS):
+        ref = np.asarray(bl._conv_unrolled(a, b, out_len))
+        got = np.asarray(bl._conv_tree(a, b, out_len))
+        np.testing.assert_array_equal(got, ref)
 
 
 def test_fp_inv_golden():
