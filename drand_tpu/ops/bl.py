@@ -336,6 +336,42 @@ def _conv_tree(a, b, out_len: int):
     return out[..., :out_len, :]
 
 
+def _conv_karatsuba(a, b, out_len: int):
+    """One Karatsuba level over the tree conv: split 32 limbs into 16/16
+    halves, compute the three 16-limb products (a0·b0, a1·b1,
+    (a0+a1)·(b0+b1)) as ONE stacked tree conv, recombine.
+
+    768 true limb products instead of 1024 (~13% fewer total VPU ops
+    after the extra adds). COEFFICIENT-exact vs schoolbook: the middle
+    term pm − p0 − p1 equals the cross-term sums per coefficient (every
+    partial product is non-negative, so no signed-intermediate hazard),
+    and the shifted recombination reproduces C[k] = Σ_{i+j=k} a_i·b_j
+    identically. Magnitudes: half-sums ≤ 2^13, pm coefficients
+    ≤ 16·2^26 = 2^30, recombined ≤ 2^28 + 2^30 + 2^28 < 2^31 — int32
+    safe. A second level would overflow the middle product's
+    (2^14)²·8 = 2^31 bound; not taken."""
+    h = NLIMBS // 2
+    a0, a1 = a[..., :h, :], a[..., h:, :]
+    b0, b1 = b[..., :h, :], b[..., h:, :]
+    pa = jnp.stack([a0, a1, a0 + a1], axis=0)
+    pb = jnp.stack([b0, b1, b0 + b1], axis=0)
+    p = _conv_tree(pa, pb, 2 * h - 1)       # (3, ..., 31, B)
+    p0, p1, pm = p[0], p[1], p[2]
+    mid = pm - p0 - p1                       # cross terms, >= 0 per coeff
+    z = jnp.zeros_like(p0[..., :1, :])
+
+    def zpad(n):
+        return jnp.broadcast_to(z, z.shape[:-2] + (n, z.shape[-1]))
+
+    full = (jnp.concatenate([p0, zpad(33)], axis=-2)
+            + jnp.concatenate([zpad(h), mid, zpad(17)], axis=-2)
+            + jnp.concatenate([zpad(2 * h), p1, zpad(1)], axis=-2))
+    if out_len <= full.shape[-2]:
+        return full[..., :out_len, :]
+    return jnp.concatenate(
+        [full, zpad(out_len - full.shape[-2])], axis=-2)
+
+
 def _conv_looped(a, b, out_len: int):
     """Same convolution as a fori_loop (compact trace for huge kernels)."""
     z = jnp.zeros_like(b)
@@ -354,13 +390,15 @@ def _conv_looped(a, b, out_len: int):
 def _conv(a, b, out_len: int):
     if CONV_MODE == "tree":
         return _conv_tree(a, b, out_len)
+    if CONV_MODE == "kara":
+        return _conv_karatsuba(a, b, out_len)
     if CONV_MODE == "unroll":
         return _conv_unrolled(a, b, out_len)
     if CONV_MODE == "loop":
         return _conv_looped(a, b, out_len)
     raise ValueError(
         f"unknown DRAND_TPU_CONV mode {CONV_MODE!r} "
-        f"(expected tree|unroll|loop)")
+        f"(expected tree|kara|unroll|loop)")
 
 
 def mont_mul(a, b):

@@ -98,6 +98,42 @@ def make_f2(inv_bit_getter=None) -> SimpleNamespace:
 F2 = make_f2()  # XLA/CPU-path namespace (kernel paths build their own)
 
 
+def _sel_fp(cond, a, b):
+    cond = jnp.asarray(cond)
+    if cond.ndim == 0:
+        return jnp.where(cond, a, b)
+    return jnp.where(cond[..., None, :], a, b)
+
+
+def make_f1(inv_bit_getter=None) -> SimpleNamespace:
+    """Batch-last Fp namespace for the generic point formulas — G1 points
+    as (X, Y, Z, inf) with coords (..., 32, B) and inf (..., B). Used by
+    the DKG deal-verification Horner kernel (ops/pallas_eval.py)."""
+
+    def inv(a):
+        return bl.fp_inv(a, inv_bit_getter)
+
+    return SimpleNamespace(
+        name="fp-bl",
+        add=bl.add,
+        sub=bl.sub,
+        neg=bl.neg,
+        mul=bl.mont_mul,
+        sqr=bl.mont_sqr,
+        mul_small=bl.mul_small,
+        inv=inv,
+        select=_sel_fp,
+        is_zero=bl.is_zero_mod_p,
+        zero=lambda bs: jnp.zeros(bs[:-1] + (NLIMBS,) + bs[-1:], DTYPE),
+        one=lambda bs: jnp.broadcast_to(
+            bl._crow("ONE"), bs[:-1] + (NLIMBS,) + bs[-1:]).astype(DTYPE),
+        elem_ndim=1,
+    )
+
+
+F1 = make_f1()  # XLA/CPU-path namespace (kernel paths build their own)
+
+
 # ---------------------------------------------------------------------------
 # ψ endomorphism (Jacobian: ψ(X, Y, Z) = (cx·X̄, cy·Ȳ, Z̄) — no inversion)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,122 @@
+"""Batch-last Pallas kernel for DKG deal verification.
+
+The deal check evaluates every dealer's public commitment polynomial at
+this node's index: ``eval_d = Σ_k C_{d,k}·(idx+1)^k`` (reference kyber
+vss VerifyDeal; BASELINE config "n=128 deal verify"). The XLA limb-path
+graph (ops/engine._eval_commits_graph) is correct but per-op-latency
+bound — measured 0.74× the HOST loop at n=128 in round 3. This kernel
+runs the same vectorized Horner — t-1 steps of ([idx+1]·acc + C_k) with
+a shared-index double-and-add ladder — as ONE fused Mosaic kernel in the
+batch-last layout (dealers on lanes, limbs on sublanes), the layout that
+took the pairing path from ~50 to ~20k checks/s.
+
+Design choices:
+- The ladder/point formulas are the generic F-parametric ones
+  (ops/curve.pt_add/pt_dbl, bl_curve.pt_mul_bits_getter) over the
+  batch-last Fp namespace (bl_curve.make_f1) — no new group law to
+  trust; golden-tested against the host oracle on the CPU path
+  (tests/test_eval_commits.py) and KAT-gated per (t, bucket) on device
+  (engine._check_eval_bucket).
+- The kernel returns JACOBIAN coordinates + infinity mask: the final
+  affine conversion needs one field inverse per dealer, which on device
+  is a 381-step Fermat ladder (~770 muls — comparable to the whole
+  t=65 Horner); the engine instead batch-inverts on host with the
+  Montgomery trick (one bigint modexp for the whole bucket).
+- Index bits ride in SMEM ((1, NBITS) int32, MSB-first), read
+  element-wise by the ladder (pallas_pairing.smem_bit_getter).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import bl
+from . import bl_curve
+from . import curve as xc
+from .bl import DTYPE, NLIMBS
+from .pallas_pairing import _pallas, smem_bit_getter
+
+# index ladder width — matches engine._EVAL_IDX_BITS (groups to n=1022)
+NBITS = 10
+LANE_BLOCK = 128
+
+
+def horner_bl(F, get_commit, bit_getter, t: int, b: int):
+    """Shared Horner body: ``acc = C_{t-1}; repeat acc = [m]·acc + C_k``
+    (k = t-2 .. 0, m = idx+1 from ``bit_getter``, MSB-first NBITS wide).
+
+    ``get_commit(k)`` returns the k-th commitment row as batch-last
+    affine ``(x, y)`` each (32, b). Returns Jacobian (X, Y, Z, inf).
+    Runs under both Mosaic (refs) and plain XLA (values) — the CPU
+    goldens exercise exactly this function."""
+    one = F.one((b,))
+    no_inf = jnp.zeros((b,), DTYPE)
+
+    x0, y0 = get_commit(t - 1)
+    state = (x0, y0, one, no_inf)
+
+    def body(i, st):
+        acc = (st[0], st[1], st[2], st[3] != 0)
+        acc = bl_curve.pt_mul_bits_getter(F, acc, bit_getter, NBITS)
+        cx, cy = get_commit(t - 2 - i)
+        acc = xc.pt_add(F, acc, (cx, cy, one, no_inf != 0))
+        return (acc[0], acc[1], acc[2], jnp.where(acc[3], 1, 0))
+
+    X, Y, Z, inf32 = jax.lax.fori_loop(0, t - 1, body, state)
+    return X, Y, Z, inf32
+
+
+def _eval_kernel(t: int, c_ref, bits_ref, xs_ref, ys_ref,
+                 ox_ref, oy_ref, oz_ref, oinf_ref):
+    from jax.experimental import pallas as pl
+
+    b = xs_ref.shape[-1]
+    with bl.const_context(c_ref[:]):
+        F = bl_curve.make_f1()
+
+        def get_commit(k):
+            # dynamic index on the untiled leading (commit) axis
+            return (xs_ref[pl.ds(k, 1), :, :][0],
+                    ys_ref[pl.ds(k, 1), :, :][0])
+
+        X, Y, Z, inf32 = horner_bl(F, get_commit,
+                                   smem_bit_getter(bits_ref), t, b)
+    ox_ref[:] = X
+    oy_ref[:] = Y
+    oz_ref[:] = Z
+    oinf_ref[:] = inf32[None, :]
+
+
+@functools.partial(jax.jit, static_argnames=("t",))
+def eval_commits_pl(xs, ys, bits, t: int):
+    """Batched commitment evaluation on the Pallas path.
+
+    xs/ys: (t, b, NLIMBS) batch-leading affine mont limbs (the engine's
+    packing layout); bits: (NBITS,) int32 MSB-first shared index.
+    Returns batch-leading Jacobian (X, Y, Z) each (b, NLIMBS) + inf (b,).
+    b must be a multiple of LANE_BLOCK; blocks run as separate kernel
+    launches inside this one jit."""
+    b = xs.shape[1]
+    if b % LANE_BLOCK:
+        raise ValueError(f"batch {b} not a LANE_BLOCK multiple")
+    xs_bl = jnp.moveaxis(xs, -1, -2)          # (t, 32, b)
+    ys_bl = jnp.moveaxis(ys, -1, -2)
+    bits2d = bits[None, :].astype(jnp.int32)  # (1, NBITS) SMEM table
+    cbuf = jnp.asarray(bl.lane_buffer(LANE_BLOCK))
+    shp = jax.ShapeDtypeStruct((NLIMBS, LANE_BLOCK), DTYPE)
+    inf_shp = jax.ShapeDtypeStruct((1, LANE_BLOCK), DTYPE)
+    call = _pallas(functools.partial(_eval_kernel, t),
+                   (shp, shp, shp, inf_shp), "vsvv")
+    outs = []
+    for s in range(0, b, LANE_BLOCK):
+        blk = slice(s, s + LANE_BLOCK)
+        outs.append(call(cbuf, bits2d, xs_bl[..., blk], ys_bl[..., blk]))
+    X = jnp.concatenate([jnp.moveaxis(o[0], 0, -1) for o in outs], axis=0)
+    Y = jnp.concatenate([jnp.moveaxis(o[1], 0, -1) for o in outs], axis=0)
+    Z = jnp.concatenate([jnp.moveaxis(o[2], 0, -1) for o in outs], axis=0)
+    inf = jnp.concatenate([o[3][0] for o in outs], axis=0)
+    return X, Y, Z, inf

@@ -1,0 +1,112 @@
+"""Batch-last Pallas kernel for the Lagrange-recovery G2 MSM.
+
+``Scheme.Recover`` (chain/beacon/chain.go:136) is one multi-scalar
+multiplication: Σ λ_i·σ_i over the chosen partials. The XLA limb-path
+``curve.msm_lanes`` works but is per-op-latency bound (r3: ~1.4 s warm
+for 67-of-100) AND, embedded inside the fused aggregator graph, rides
+the known-flaky plain-XLA-between-Mosaic-kernels regime. This kernel
+runs the whole MSM as ONE Mosaic program in the batch-last layout
+(partials on lanes, limbs on sublanes):
+
+- per-lane 255-step double-and-add ladders, vectorized across lanes —
+  the scalar bits ride in VMEM ((nbits, B) int32, one row read per step);
+- a log2(B)-step cross-lane fold by lane ROTATION: after step w every
+  lane i < w holds the sum of lanes {i, i+w}; lane 0 ends with the
+  total (7 extra point-adds at B=128 — noise next to the ladder);
+- in-kernel to-affine (Fermat inverse via the SMEM p−2 bit table, as
+  ops/pallas_wire's kernels do) so no XLA-limb arithmetic touches the
+  result before it feeds the pairing rows of the fused graph.
+
+Point formulas are the generic F-parametric ones (ops/curve) over the
+batch-last Fp2 namespace (bl_curve.make_f2) — the same code the CPU
+golden tests pin. Callers always verify the recovered signature
+cryptographically (the fused graph in-batch; engine.recover's callers
+via VerifyRecovered), so a miscompile cannot produce an accepted wrong
+signature — it surfaces as a failed round, and the fused path's KAT
+(engine._check_agg_bucket) additionally gates this kernel's executable
+on device.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import bl
+from . import bl_curve
+from . import curve as xc
+from .bl import DTYPE, NLIMBS
+from .pallas_pairing import PM2_FLAT, _pallas, smem_bit_getter
+
+LANES = 128  # one VREG of lanes; recovery thresholds pad up to this
+
+
+def _roll(a, w: int):
+    return jnp.roll(a, -w, axis=-1)
+
+
+def msm_fold_bl(F, p, nlanes: int):
+    """Cross-lane log-tree fold: returns the point whose lane 0 is the
+    sum over all ``nlanes`` input lanes (other lanes carry garbage)."""
+    X, Y, Z, inf = p
+    inf32 = jnp.where(inf, 1, 0)[None, :]  # 2-D: Mosaic-safe rolls
+    w = nlanes // 2
+    while w >= 1:
+        q = (_roll(X, w), _roll(Y, w), _roll(Z, w), _roll(inf32, w)[0] != 0)
+        X, Y, Z, inf = xc.pt_add(F, (X, Y, Z, inf32[0] != 0), q)
+        inf32 = jnp.where(inf, 1, 0)[None, :]
+        w //= 2
+    return X, Y, Z, inf32[0] != 0
+
+
+def _msm_kernel(nbits: int, c_ref, pm2_ref, bits_ref, xs_ref, ys_ref,
+                inf_ref, ox_ref, oy_ref, oinf_ref):
+    from jax.experimental import pallas as pl
+
+    with bl.const_context(c_ref[:]):
+        F = bl_curve.make_f2(smem_bit_getter(pm2_ref))
+        b = xs_ref.shape[-1]
+        one2 = F.one((b,))
+        pts = (xs_ref[:], ys_ref[:], one2, inf_ref[:][0] != 0)
+
+        def bit_getter(i):
+            # per-lane bit row: (b,) int32 vector select in the ladder
+            return bits_ref[pl.ds(i, 1), :][0]
+
+        acc = bl_curve.pt_mul_bits_getter(F, pts, bit_getter, nbits)
+        total = msm_fold_bl(F, acc, b)
+        ax, ay, ainf = xc.pt_to_affine(F, total)
+    ox_ref[:] = ax
+    oy_ref[:] = ay
+    oinf_ref[:] = jnp.where(ainf, 1, 0)[None, :]
+
+
+@functools.partial(jax.jit, static_argnames=("nbits",))
+def msm_g2_pl(xs, ys, inf, bits, nbits: int = 255):
+    """Σ bits_i ⋅ P_i over G2 on the Pallas path.
+
+    xs/ys: (b, 2, NLIMBS) batch-leading affine mont limbs; inf: (b,)
+    bool mask (padding rows); bits: (b, nbits) int32 MSB-first scalars.
+    b must equal LANES (the engine pads). Returns affine
+    (x (2, NLIMBS), y (2, NLIMBS), inf ()) of the sum — device arrays,
+    usable directly inside an enclosing jit (the fused aggregator)."""
+    b = xs.shape[0]
+    if b != LANES:
+        raise ValueError(f"msm_g2_pl needs exactly {LANES} lanes, got {b}")
+    xs_bl = jnp.moveaxis(jnp.asarray(xs), 0, -1)   # (2, 32, b)
+    ys_bl = jnp.moveaxis(jnp.asarray(ys), 0, -1)
+    inf2 = jnp.asarray(inf).astype(jnp.int32)[None, :]        # (1, b)
+    bits_bl = jnp.asarray(bits).T.astype(jnp.int32)           # (nbits, b)
+    cbuf = jnp.asarray(bl.lane_buffer(LANES))
+    pm2 = jnp.asarray(PM2_FLAT)
+    shp = jax.ShapeDtypeStruct((2, NLIMBS, LANES), DTYPE)
+    inf_shp = jax.ShapeDtypeStruct((1, LANES), DTYPE)
+    ax, ay, ainf = _pallas(
+        functools.partial(_msm_kernel, nbits),
+        (shp, shp, inf_shp), "vsvvvv")(
+        cbuf, pm2, bits_bl, xs_bl, ys_bl, inf2)
+    # lane 0 holds the fold result
+    return ax[..., 0], ay[..., 0], ainf[0, 0] != 0

@@ -4,12 +4,16 @@ Sharding/multi-chip tests run on a virtual 8-device CPU mesh (the driver
 separately dry-run-compiles the multi-chip path; real TPU hardware has one
 chip under axon). Set up the XLA flags BEFORE jax is imported anywhere.
 
-NB: under the axon image a sitecustomize imports jax at interpreter boot,
-so the JAX_PLATFORMS assignment below only takes effect when the suite runs
-with a clean PYTHONPATH (PYTHONPATH= JAX_PLATFORMS=cpu python -m pytest …);
-under the ambient environment the suite runs against the tunneled TPU chip,
-which is also a valid (slower, hardware-exercising) configuration. Tests
-that REQUIRE more than one device must check jax.device_count() and skip.
+NB: under the axon image a sitecustomize imports jax at interpreter boot
+and registers the tunneled TPU backend — the JAX_PLATFORMS env var is
+read too early to override it, which used to make an innocent
+``pytest tests/`` run every graph against the (slow, possibly down)
+tunnel. ``jax.config.update("jax_platforms", "cpu")`` DOES override it
+post-import (the backend itself initializes lazily), so the suite pins
+the CPU mesh programmatically and the documented fast path
+(`-m "not device and not slow"`, <5 min) works for a cold user with no
+environment knowledge. Set DRAND_TPU_TEST_TPU=1 to deliberately run the
+suite against the real device instead.
 """
 
 import asyncio
@@ -17,15 +21,19 @@ import inspect
 import os
 import sys
 
-# Force-assign (not setdefault): the ambient shell defaults to
-# JAX_PLATFORMS=axon (remote TPU tunnel); the test suite prefers the
-# virtual CPU mesh when jax has not been imported yet.
-os.environ["JAX_PLATFORMS"] = "cpu"
+# XLA_FLAGS must be in place before the (lazy) backend initialization
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+if os.environ.get("DRAND_TPU_TEST_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    # effective even when the axon sitecustomize already imported jax
+    # and registered the tunnel backend (env vars alone are not)
+    jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -181,3 +181,116 @@ def test_msm_lanes_matches_host():
         Fp2.one())
     assert not bool(np.asarray(is_inf))
     assert got == exp
+
+
+def test_horner_bl_matches_host():
+    """The batch-last Horner body behind the Pallas deal-verify kernel
+    (ops/pallas_eval.horner_bl), run on the XLA path: Jacobian output
+    converted on host must equal every dealer's PubPoly.eval."""
+    import jax.numpy as jnp
+
+    from drand_tpu.ops import bl_curve, curve as xcurve, limb, pallas_eval
+    from drand_tpu.ops.engine import BatchedEngine, _g1_xy
+    from drand_tpu.ops.pallas_pairing import value_bit_getter
+
+    t, b, index = 3, 4, 6
+    g = PointG1.generator()
+    polys = [PubPoly([g.mul(97 * d + 13 * k + 1) for k in range(t)])
+             for d in range(b)]
+    xs = np.zeros((t, limb.NLIMBS, b), np.int32)
+    ys = np.zeros((t, limb.NLIMBS, b), np.int32)
+    flat = PointG1.batch_to_affine([c for p in polys for c in p.commits])
+    for d in range(b):
+        for k in range(t):
+            aff = _g1_xy(flat[d * t + k])
+            xs[k, :, d], ys[k, :, d] = aff[0], aff[1]
+    bits = xcurve.scalar_to_bits(index + 1, pallas_eval.NBITS)
+    F = bl_curve.make_f1()
+    import jax
+
+    xs_j, ys_j = jnp.asarray(xs), jnp.asarray(ys)
+
+    def get_commit(k):  # k is traced inside fori_loop on the XLA path
+        return (jax.lax.dynamic_index_in_dim(xs_j, k, 0, keepdims=False),
+                jax.lax.dynamic_index_in_dim(ys_j, k, 0, keepdims=False))
+
+    X, Y, Z, inf32 = pallas_eval.horner_bl(
+        F, get_commit, value_bit_getter(jnp.asarray(bits)[None, :]), t, b)
+    # batch-last -> batch-leading rows, then the engine's host unpack
+    rows = np.concatenate(
+        [np.asarray(X).T, np.asarray(Y).T, np.asarray(Z).T,
+         np.asarray(inf32)[:, None]], axis=1)
+    got = BatchedEngine._unpack_eval_host(rows, 3, b)
+    exp = [p.eval(index).value for p in polys]
+    assert got == exp
+
+
+def test_unpack_eval_jacobian_infinity_row():
+    """Jacobian host unpack: z=0 / inf-flagged rows come back as the
+    point at infinity; finite rows convert exactly."""
+    from drand_tpu.ops import limb
+    from drand_tpu.ops.engine import BatchedEngine
+    from drand_tpu.crypto.fields import P as _P
+
+    g = PointG1.generator()
+    x, y = g.to_affine()
+    z = 12345
+    # jacobian (X, Y, Z) = (x z^2, y z^3, z)
+    X = limb.int_to_mont_limbs(x.v * z * z % _P)
+    Y = limb.int_to_mont_limbs(y.v * z * z % _P * z % _P)
+    Z = limb.int_to_mont_limbs(z)
+    zero = np.zeros(limb.NLIMBS, np.int32)
+    rows = np.stack([
+        np.concatenate([X, Y, Z, [0]]),
+        np.concatenate([zero, zero, zero, [1]]),
+    ]).astype(np.int32)
+    got = BatchedEngine._unpack_eval_host(rows, 3, 2)
+    assert got[0] == g
+    assert got[1].is_infinity()
+
+
+def test_msm_fold_bl_matches_host():
+    """The batch-last ladder + lane-roll log-fold + to-affine behind the
+    Pallas recovery MSM (ops/pallas_msm), on the XLA path: lane 0 must
+    equal the host Σ s_i·P_i, with padding lanes masked as infinity."""
+    import jax
+    import jax.numpy as jnp
+
+    from drand_tpu.crypto.fields import Fp2
+    from drand_tpu.ops import bl_curve, curve as xcurve, limb, pallas_msm
+    from drand_tpu.ops.engine import _g2_aff
+
+    rnd = random.Random(3)
+    b, nbits = 8, 48
+    pts = [PointG2.generator().mul(rnd.randrange(1, 1 << 40))
+           for _ in range(b - 3)]
+    scalars = [rnd.randrange(1, 1 << nbits) for _ in pts]
+    arr = np.zeros((b, 2, 2, limb.NLIMBS), np.int32)
+    inf = np.ones(b, bool)
+    bits = np.zeros((b, nbits), np.int32)
+    for i, (p, s) in enumerate(zip(pts, scalars)):
+        arr[i] = _g2_aff(p)
+        inf[i] = False
+        bits[i] = xcurve.scalar_to_bits(s, nbits)
+    F = bl_curve.F2
+    xq = jnp.moveaxis(jnp.asarray(arr[:, 0]), 0, -1)   # (2, 32, b)
+    yq = jnp.moveaxis(jnp.asarray(arr[:, 1]), 0, -1)
+    bits_bl = jnp.asarray(bits.T)                      # (nbits, b)
+
+    def bit_getter(i):
+        return jax.lax.dynamic_slice_in_dim(bits_bl, i, 1, 0)[0]
+
+    acc = bl_curve.pt_mul_bits_getter(
+        F, (xq, yq, F.one((b,)), jnp.asarray(inf)), bit_getter, nbits)
+    ax, ay, ainf = xcurve.pt_to_affine(
+        F, pallas_msm.msm_fold_bl(F, acc, b))
+    ax, ay = np.asarray(ax)[..., 0], np.asarray(ay)[..., 0]
+    assert not bool(np.asarray(ainf)[0])
+    got = PointG2(
+        Fp2(limb.fp_from_device(ax[0]), limb.fp_from_device(ax[1])),
+        Fp2(limb.fp_from_device(ay[0]), limb.fp_from_device(ay[1])),
+        Fp2.one())
+    exp = PointG2.infinity()
+    for p, s in zip(pts, scalars):
+        exp = exp.add(p.mul(s))
+    assert got == exp

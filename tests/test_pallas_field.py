@@ -101,16 +101,20 @@ def test_conv_modes_agree():
 
 
 def test_conv_tree_bit_identical_raw():
-    # the tree form must be a pure reassociation: identical RAW limb
-    # coefficients (not just values) to the windowed schoolbook form,
-    # for both the 64-limb product and the 32-limb low-half conv
+    # tree and karatsuba forms must be pure reassociations: identical
+    # RAW limb coefficients (not just values) to the windowed schoolbook
+    # form, for both the 64-limb product and the 32-limb low-half conv,
+    # incl. worst-case lazy-carry magnitudes (limbs up to 2^13)
     rng = np.random.default_rng(7)
-    a = jnp.asarray(rng.integers(0, 1 << 12, (bl.NLIMBS, 4), dtype=np.int32))
-    b = jnp.asarray(rng.integers(0, 1 << 12, (bl.NLIMBS, 4), dtype=np.int32))
-    for out_len in (2 * bl.NLIMBS, bl.NLIMBS):
-        ref = np.asarray(bl._conv_unrolled(a, b, out_len))
-        got = np.asarray(bl._conv_tree(a, b, out_len))
-        np.testing.assert_array_equal(got, ref)
+    for hi in (1 << 12, 1 << 13):
+        a = jnp.asarray(rng.integers(0, hi, (bl.NLIMBS, 4), dtype=np.int32))
+        b = jnp.asarray(rng.integers(0, hi, (bl.NLIMBS, 4), dtype=np.int32))
+        for out_len in (2 * bl.NLIMBS, bl.NLIMBS):
+            ref = np.asarray(bl._conv_unrolled(a, b, out_len))
+            np.testing.assert_array_equal(
+                np.asarray(bl._conv_tree(a, b, out_len)), ref)
+            np.testing.assert_array_equal(
+                np.asarray(bl._conv_karatsuba(a, b, out_len)), ref)
 
 
 def test_fp_inv_golden():
