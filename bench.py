@@ -50,13 +50,21 @@ def emit(obj):
     print(json.dumps(obj), flush=True)
 
 
-def best_of(trials, fn):
-    best = None
+def trials_of(trials, fn):
+    """(best, sorted trial list) of ``trials`` runs — the tunnel's ~20x
+    load variance makes best-window a device-time estimate and the
+    median the steady-state estimate; headline reports both (VERDICT r4
+    weak #5)."""
+    vals = []
     for i in range(trials):
         v = fn()
         log(f"  trial {i}: {v:.2f}")
-        best = v if best is None else min(best, v)
-    return best
+        vals.append(v)
+    return min(vals), sorted(vals)
+
+
+def best_of(trials, fn):
+    return trials_of(trials, fn)[0]
 
 
 def _mk_pool(sk, pool=8):
@@ -145,22 +153,25 @@ def bench_headline(trials, min_seconds):
                 raise RuntimeError("self-check failed inside timed loop")
             return dt / k
 
-        per_call = best_of(trials, timed)
+        per_call, tvals = trials_of(trials, timed)
+        per_call_med = tvals[len(tvals) // 2]
         rate = 2 * batch / per_call
         log(f"batch {batch}: {per_call * 1e3:.1f} ms/call best "
             f"-> {rate:.0f} pairings/s")
         if best_rate is None or rate > best_rate[0]:
-            best_rate = (rate, batch, per_call)
+            best_rate = (rate, batch, per_call, per_call_med)
         measured += 1
         if measured >= 2:
             break  # two good sizes suffice; smaller ones are fallbacks
     if best_rate is None:
         log("FATAL: no batch size produced correct results")
         raise SystemExit(1)
-    rate, batch, per_call = best_rate
+    rate, batch, per_call, per_call_med = best_rate
     return {"metric": "pairings_per_sec", "value": round(rate, 1),
             "unit": "pairings/s", "vs_baseline": round(rate / 200000.0, 4),
-            "batch": batch, "ms_per_call": round(per_call * 1e3, 2)}
+            "batch": batch, "ms_per_call": round(per_call * 1e3, 2),
+            "median_rate": round(2 * batch / per_call_med, 1),
+            "median_ms_per_call": round(per_call_med * 1e3, 2)}
 
 
 def bench_catchup(trials, n_rounds=10_000):
@@ -423,16 +434,24 @@ def bench_replay_measured(budget_left, catchup_result=None):
     bad_rounds = 0
     t0 = time.perf_counter()
     launches = []
+
+    def drain():
+        # a row passes iff (ok & valid) within [:n] — matching the
+        # self-check above; a short final bucket's _PAD_SIG padding rows
+        # beyond n are NOT failures (ADVICE r4)
+        got = np.asarray(jnp.stack([d for d, _, _ in launches]))
+        bad = 0
+        for row, (_, valid, n) in zip(got, launches):
+            bad += int((~(row & valid))[:n].sum())
+        launches.clear()
+        return bad
+
     for i in range(n_chunks):
         launches.append(eng.dispatch_wire_packed(buckets[i % len(buckets)]))
         if len(launches) >= drain_every:
-            got = np.asarray(jnp.stack([d for d, _, _ in launches]))
-            bad_rounds += int((~got).sum())
-            launches.clear()
+            bad_rounds += drain()
     if launches:
-        got = np.asarray(jnp.stack([d for d, _, _ in launches]))
-        bad_rounds += int((~got).sum())
-        launches.clear()
+        bad_rounds += drain()
     dt = time.perf_counter() - t0
     if bad_rounds:
         raise RuntimeError(f"replay: {bad_rounds} rounds failed "

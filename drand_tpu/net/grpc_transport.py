@@ -299,24 +299,19 @@ class GrpcGateway:
             msg, from_addr = wire.decode(request)
         except wire.WireError:
             try:
-                if not request:
-                    # proto3 decodes b"" to an all-defaults message; an
-                    # empty request must not silently start a full chain
-                    # sync from round 0 (ADVICE r3)
-                    raise pw.WireError("empty SyncChain request")
                 req = pw.decode(pw.SYNC_REQUEST, request)
-                if not req.get("from_round"):
-                    # nearly-arbitrary bytes can proto3-parse to an
-                    # all-defaults message; a real reference node always
-                    # syncs from last-stored+1 >= 1 (protocol.proto:84-88)
-                    raise pw.WireError(
-                        "SyncChain request decodes to from_round=0 — "
-                        "rejecting ambiguous payload")
             except pw.WireError as e:
                 await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
                 return
             proto = True
-            msg = SyncRequest(from_round=req["from_round"])
+            # from_round=0 (which proto3 encodes as the EMPTY message) is
+            # a full-chain sync request in the reference
+            # (chain/beacon/sync.go:134-150); serve it from round 1 —
+            # round 0 is the locally-derivable genesis beacon.
+            # Documented deviation: we cannot distinguish an
+            # intentionally-empty request from a zero-valued one, both
+            # get the full chain (ADVICE r4 reversing the r3 rejection).
+            msg = SyncRequest(from_round=req.get("from_round") or 1)
             from_addr = context.peer()
         try:
             async for b in self._svc.sync_chain(from_addr, msg):

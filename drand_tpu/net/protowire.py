@@ -228,17 +228,26 @@ def decode(spec: dict, data: bytes) -> dict:
         if repeated:
             out[name].append(val)
         else:
+            # re-insert so dict order reflects LAST wire occurrence of
+            # each scalar field (oneof_of's last-wins relies on this;
+            # the dict is pre-populated with defaults in spec order)
+            out.pop(name, None)
             out[name] = val
     return out
 
 
 def oneof_of(decoded: dict, arms: tuple[str, ...]):
-    """(arm_name, value) for the single populated oneof arm, or
-    (None, None); raises WireError if several arms are set."""
-    hit = [(a, decoded[a]) for a in arms if decoded.get(a) is not None]
-    if len(hit) > 1:
-        raise WireError(f"oneof with multiple arms set: {[a for a, _ in hit]}")
-    return hit[0] if hit else (None, None)
+    """(arm_name, value) for the populated oneof arm, or (None, None).
+
+    proto3 oneof semantics is last-value-wins, so when several arms are
+    populated (a non-canonical but spec-legal encoding) the arm set
+    LATEST in wire order wins. ``decode`` re-inserts a scalar field's
+    dict key on every wire occurrence, so insertion order among
+    populated arms IS last-wire-occurrence order (ADVICE r4, replacing
+    the strict rejection)."""
+    hit = [(a, decoded[a]) for a in decoded
+           if a in arms and decoded.get(a) is not None]
+    return hit[-1] if hit else (None, None)
 
 
 # ---------------------------------------------------------------------------

@@ -301,33 +301,55 @@ def _conv_unrolled(a, b, out_len: int):
 
 
 def _conv_tree(a, b, out_len: int):
-    """Product rows + log-tree aligned accumulation.
+    """Product rows + log-tree aligned accumulation, TRUNCATED at out_len.
 
-    Row i is the UNPADDED product a_i * b (32 limbs, value offset i);
-    rows then combine pairwise — each combine concatenates one zero
-    block of the offset delta and adds, so row lengths grow 32 -> 33 ->
-    35 -> 39 -> 47 -> 63 instead of every row being an out_len-wide
-    window. Versus _conv_unrolled this executes exactly the n*m true
-    limb products (the windowed form multiplies ~50% zeros at
-    out_len=2n) and ~out_len*log(n) accumulation adds instead of
-    out_len*n. Values are bit-identical (pure reassociation of the same
-    non-negative int32 sums — the 2^29 coefficient bound of the
-    schoolbook form is unchanged). Mosaic-safe: static slices, concats
-    and elementwise ops only."""
+    Row i is the UNPADDED product a_i * b, pre-clipped to the limbs that
+    can reach output index < out_len (row i feeds outputs [i, i+32), so
+    it keeps min(32, out_len - i) limbs); rows then combine pairwise —
+    each combine concatenates one zero block of the offset delta and
+    adds. The construction clip is the only clip needed: inductively
+    offset + len <= out_len for every row, so combined lengths never
+    exceed out_len - offset. For out_len = 2n (the product convs)
+    nothing is clipped and row lengths grow 32 -> 33 -> 35 -> 39 ->
+    47 -> 63; for out_len = n (the REDC NPRIME conv) only the
+    lower-triangular n(n+1)/2 = 528 of 1024 products are executed —
+    everything clipped was discarded by the final slice before. Versus
+    _conv_unrolled this executes exactly the true limb products (the
+    windowed form multiplies ~50% zeros at out_len=2n and ~75% at
+    out_len=n) and ~out_len*log(n) accumulation adds instead of
+    out_len*n. Values are bit-identical on [0, out_len) (pure
+    reassociation of the same non-negative int32 sums — the 2^29
+    coefficient bound of the schoolbook form is unchanged). Mosaic-safe:
+    static slices, concats and elementwise ops only."""
     n = a.shape[-2]
-    rows = [a[..., i:i + 1, :] * b for i in range(n)]  # value offset = i
-    d = 1
+    # (row, offset): row i clipped to the limbs below out_len
+    rows = [(a[..., i:i + 1, :] * b[..., :min(n, out_len - i), :], i)
+            for i in range(n) if out_len - i > 0]
+    def pad_to(v, ln, lead=0):
+        parts = []
+        if lead:
+            parts.append(jnp.zeros(v.shape[:-2] + (lead, v.shape[-1]),
+                                   v.dtype))
+        parts.append(v)
+        tail = ln - lead - v.shape[-2]
+        if tail:
+            parts.append(jnp.zeros(v.shape[:-2] + (tail, v.shape[-1]),
+                                   v.dtype))
+        return parts[0] if len(parts) == 1 else jnp.concatenate(
+            parts, axis=-2)
+
     while len(rows) > 1:
-        assert len(rows) % 2 == 0, "power-of-two limb count expected"
         nxt = []
-        for j in range(0, len(rows), 2):
-            x, y = rows[j], rows[j + 1]  # offsets j*d, (j+1)*d
-            z = jnp.zeros_like(x[..., :d, :])
-            nxt.append(jnp.concatenate([x, z], axis=-2)
-                       + jnp.concatenate([z, y], axis=-2))
+        for j in range(0, len(rows) - 1, 2):
+            (x, ox), (y, oy) = rows[j], rows[j + 1]
+            d = oy - ox
+            keep = max(x.shape[-2], d + y.shape[-2])  # <= out_len - ox
+            nxt.append((pad_to(x, keep) + pad_to(y, keep, lead=d), ox))
+        if len(rows) % 2:
+            nxt.append(rows[-1])
         rows = nxt
-        d *= 2
-    out = rows[0]
+    out, off = rows[0]
+    assert off == 0
     got = out.shape[-2]
     if got < out_len:
         z = jnp.zeros(out.shape[:-2] + (out_len - got, out.shape[-1]),

@@ -50,8 +50,20 @@ def backend_already_up() -> bool:
 
 
 _PROBE_RESULT: Optional[bool] = None
+_PROBE_TIME: float = 0.0
 _PROBE_THREAD: Optional[threading.Thread] = None
 _PROBE_LOCK = threading.Lock()
+
+# A negative verdict expires: a daemon outliving a tunnel outage must
+# regain the device path without a restart (ADVICE r4). Positive verdicts
+# are permanent — once a backend initialized in-process it stays up.
+NEG_PROBE_TTL = float(os.environ.get("DRAND_TPU_PROBE_TTL", "300"))
+
+
+def _probe_expired() -> bool:
+    return (_PROBE_RESULT is False
+            and NEG_PROBE_TTL > 0
+            and time.monotonic() - _PROBE_TIME > NEG_PROBE_TTL)
 
 
 def probe_backend(timeout: float = 90.0, *, cache: bool = True) -> bool:
@@ -75,8 +87,8 @@ def probe_backend(timeout: float = 90.0, *, cache: bool = True) -> bool:
     ``DRAND_TPU_PROBE_TIMEOUT`` overrides ``timeout``; ``0`` skips the
     probe entirely (always "up" — for environments known to be local).
     """
-    global _PROBE_RESULT
-    if cache and _PROBE_RESULT is not None:
+    global _PROBE_RESULT, _PROBE_TIME
+    if cache and _PROBE_RESULT is not None and not _probe_expired():
         return _PROBE_RESULT
     if backend_already_up():
         _PROBE_RESULT = True
@@ -90,7 +102,7 @@ def probe_backend(timeout: float = 90.0, *, cache: bool = True) -> bool:
         if _PROBE_RESULT is not None:
             return _PROBE_RESULT
     with _PROBE_LOCK:
-        if cache and _PROBE_RESULT is not None:
+        if cache and _PROBE_RESULT is not None and not _probe_expired():
             return _PROBE_RESULT
         env_t = os.environ.get("DRAND_TPU_PROBE_TIMEOUT")
         if env_t is not None:
@@ -120,14 +132,20 @@ def probe_backend(timeout: float = 90.0, *, cache: bool = True) -> bool:
             except Exception:  # noqa: BLE001 — flapping tunnel
                 ok = False
         _PROBE_RESULT = ok
+        _PROBE_TIME = time.monotonic()
         return ok
 
 
 def probe_state() -> Optional[bool]:
     """Cached probe verdict: True/False, or None when no probe has
-    completed yet."""
+    completed yet. A negative verdict older than ``NEG_PROBE_TTL``
+    triggers a background re-probe (and keeps answering False until it
+    completes) — long-lived daemons regain the device path when the
+    tunnel recovers."""
     if backend_already_up():
         return True
+    if _probe_expired():
+        probe_backend_bg()
     return _PROBE_RESULT
 
 
@@ -138,7 +156,7 @@ def probe_backend_bg(timeout: float = 90.0) -> None:
     The daemon calls this at startup; crypto/batch.engine calls it on
     first use from loop context."""
     global _PROBE_THREAD
-    if _PROBE_RESULT is not None or (
+    if (_PROBE_RESULT is not None and not _probe_expired()) or (
             _PROBE_THREAD is not None and _PROBE_THREAD.is_alive()):
         return
     _PROBE_THREAD = threading.Thread(

@@ -192,14 +192,15 @@ def test_repeated_keeps_default_elements_and_packed_varints():
     assert pw.decode(spec, b"\x08\x05\x08\x07")["xs"] == [5, 7]
 
 
-def test_oneof_multiple_arms_rejected():
+def test_oneof_multiple_arms_last_wins():
+    # proto3 oneof semantics: the last-populated arm wins (ADVICE r4)
     two = {"deal": {"dealer_index": 1, "commits": [], "deals": [],
                     "session_id": b"", "signature": b""},
            "response": {"share_index": 1, "responses": [],
                         "session_id": b"", "signature": b""},
            "justification": None}
-    with pytest.raises(pw.WireError, match="oneof"):
-        pw.oneof_of(two, pw.DKG_BUNDLE_ARMS)
+    arm, val = pw.oneof_of(two, pw.DKG_BUNDLE_ARMS)
+    assert arm == "response" and val["share_index"] == 1
 
 
 # ---------------------------------------------------------------------------
@@ -297,14 +298,14 @@ async def test_interop_protobuf_sync_chain():
             assert msg["signature"] == b"s%d" % msg["round"]
         assert rounds == [2, 3]
 
-        # ADVICE r3 guard: an empty request (proto3 all-defaults) and a
-        # from_round=0 request must be rejected, not start a full sync
-        for bad in (b"", pw.encode(pw.SYNC_REQUEST, {"from_round": 0})):
-            stream = ch.unary_stream("/drand.Protocol/SyncChain")(bad)
-            with pytest.raises(grpc.aio.AioRpcError) as ei:
-                async for _ in stream:
-                    pass
-            assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        # ADVICE r4: from_round=0 — which proto3 encodes as the EMPTY
+        # message — is the reference's full-chain sync request
+        # (chain/beacon/sync.go:134-150); both forms stream from round 1
+        for full in (b"", pw.encode(pw.SYNC_REQUEST, {"from_round": 0})):
+            stream = ch.unary_stream("/drand.Protocol/SyncChain")(full)
+            rounds = [pw.decode(pw.BEACON_PACKET, raw)["round"]
+                      async for raw in stream]
+            assert rounds == [1, 2, 3]
         await ch.close()
     finally:
         await gw.stop()
