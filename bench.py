@@ -154,7 +154,9 @@ def bench_headline(trials, min_seconds):
             return dt / k
 
         per_call, tvals = trials_of(trials, timed)
-        per_call_med = tvals[len(tvals) // 2]
+        mid = len(tvals) // 2
+        per_call_med = (tvals[mid] if len(tvals) % 2
+                        else (tvals[mid - 1] + tvals[mid]) / 2)
         rate = 2 * batch / per_call
         log(f"batch {batch}: {per_call * 1e3:.1f} ms/call best "
             f"-> {rate:.0f} pairings/s")

@@ -107,6 +107,56 @@ _PMULT_33 = np.stack([_x.int_to_limbs(k * P, NLIMBS + 1)
                       for k in range(_x.R_MONT // P + 1)])
 N_PMULT = _PMULT_33.shape[0]
 
+# ---- lazy-reduction complement profiles (see "Lazy reduction" below) ----
+#
+# An unreduced subtraction x - y is computed borrow-free as
+# x + (CMAX - y) + D where CMAX is a per-limb upper bound on y and
+# D ≡ -Σ CMAX_k 2^12k (mod p). CMAX profiles are VALUE-AWARE: limb k of
+# a value <= Yv is <= Yv >> 12k, so the numeric inflation of the
+# complement stays ~2x the subtrahend's value bound instead of
+# CMAX_flat * 2^(12 width) — this is what keeps redc's wrap convergence
+# at a handful of passes.
+_A_INV = 4100                                # engine-invariant limb bound
+_UW = 2 * NLIMBS + 2                         # canonical unreduced width
+
+
+def _usub_profile(flat: int, width: int, value_bound: int) -> list[int]:
+    # limb k of a non-negative-limb value <= Yv is <= floor(Yv / 2^12k)
+    return [min(flat, value_bound >> (12 * k)) for k in range(width)]
+
+
+_USUB_PROFILES = {
+    # raw product convolution: triangular coefficient-count profile
+    # (count(k) operand pairs, each product <= 4100^2), width 64
+    "C": [(min(k, 31) - max(0, k - 31) + 1) * _A_INV * _A_INV
+          if k < 63 else 0 for k in range(64)],
+    # f2-core outputs (limbs <= 2^18.1 after fold, value <= 2^770)
+    "T": _usub_profile(1 << 19, _UW, 1 << 771),
+    # sums of two f2-core outputs
+    "S": _usub_profile(1 << 20, _UW, 1 << 772),
+    # xi-combine inputs at the f6 level (<= 2^20.4, value <= 2^772.5)
+    "X": _usub_profile(1 << 21, _UW, 1 << 773),
+    # single f6-core output coefficient (<= 2^22, value <= 2^774.2)
+    "Y": _usub_profile(1 << 23, _UW, 1 << 775),
+    # sums of two f6-core coefficients / xi outputs at the f12 level
+    "Z": _usub_profile(1 << 24, _UW, 1 << 777),
+}
+
+
+def _usub_rows():
+    out = []
+    for name, prof in _USUB_PROFILES.items():
+        w_total = sum(c << (12 * k) for k, c in enumerate(prof))
+        pad = (-len(prof)) % NLIMBS
+        rows = np.asarray(prof + [0] * pad, dtype=np.int32).reshape(
+            -1, NLIMBS)
+        out.append((f"UC_{name}", rows))
+        out.append((f"UD_{name}",
+                    np.asarray(_x.int_to_limbs((-w_total) % P),
+                               dtype=np.int32)[None, :]))
+    return out
+
+
 _CONST_SECTIONS = [
     ("P", np.asarray(_x.P_LIMBS, dtype=np.int32)[None, :]),
     ("ONE", np.asarray(_x.ONE_MONT, dtype=np.int32)[None, :]),
@@ -118,7 +168,7 @@ _CONST_SECTIONS = [
     ("GAMMA3", _GAMMA_ROWS[3]),
     ("PM2", _PM2_ROWS),
     ("PMULT_LO", _PMULT_33[:, :NLIMBS].astype(np.int32)),
-]
+] + _usub_rows()
 _OFFSETS: dict[str, tuple[int, int]] = {}
 
 
@@ -455,6 +505,167 @@ def mont_sqr(a):
     return mont_mul(a, a)
 
 
+# ---------------------------------------------------------------------------
+# Lazy reduction (BLST-style): accumulate unreduced products, REDC once.
+#
+# A "lazy" value is a plain (..., w, B) int32 array, w in [64, _UW],
+# holding non-negative limbs of an UNREDUCED integer congruent (mod p)
+# to the product/combination it represents; ``redc`` turns it into an
+# engine-invariant Montgomery field element. f2/f6/f12 multiplication
+# computes all product convolutions first, combines them linearly in the
+# lazy domain (adds, profile-complemented subs, xi twists — no REDC),
+# and reduces ONCE per output coefficient: per f12_mul the REDC count
+# drops from 54 to 12 (per f6_mul 18 -> 6, per f2_mul 3 -> 2) while the
+# convolution count is unchanged. Bounds are tracked statically at each
+# call site (comments); every site keeps limbs < 2^30 ahead of redc and
+# < 2^31 everywhere (int32).
+#
+# Product convolutions on this path ALWAYS use the tree conv: the "C"
+# complement profile is the schoolbook/tree triangular coefficient
+# bound, which Karatsuba recombination does not satisfy.
+# ---------------------------------------------------------------------------
+
+LAZY = __import__("os").environ.get("DRAND_TPU_LAZY", "1") == "1"
+
+
+def _u_pad(t, w: int):
+    k = w - t.shape[-2]
+    if k == 0:
+        return t
+    z = jnp.zeros(t.shape[:-2] + (k, t.shape[-1]), t.dtype)
+    return jnp.concatenate([t, z], axis=-2)
+
+
+def _u_fold1(t):
+    """One carry-fold round, +1 limb: limbs < 2^30 -> <= MASK + 2^18."""
+    return _fold(t, rounds=1, grow=True)
+
+
+def _u_sub(x, y, site: str):
+    """x - y (mod p) in the lazy domain, borrow-free:
+    x + (CMAX_site - y) + D_site. ``y`` must match the site's profile
+    width and per-limb/value bounds (see _USUB_PROFILES); x.width >=
+    y.width. Result width = x.width, limbs <= x.bound + CMAX_flat +
+    MASK; value <= x.value + ~2*y.value_bound + p."""
+    prof_rows = _csec(f"UC_{site}")
+    # (m, 32[, B]) rows -> (m*32[, B]) profile via concat of row slices
+    # (NOT reshape — Mosaic has no general reshape lowering)
+    prof = jnp.concatenate([prof_rows[i] for i in range(prof_rows.shape[0])],
+                           axis=0)
+    if prof.ndim == 1:
+        prof = prof[:, None]
+    yw = y.shape[-2]
+    comp = prof[:yw] - y
+    d = _colrow(_csec(f"UD_{site}")[0])
+    xw = x.shape[-2]
+    low = x[..., :NLIMBS, :] + comp[..., :NLIMBS, :] + d
+    mid = x[..., NLIMBS:yw, :] + comp[..., NLIMBS:, :]
+    parts = [low, mid]
+    if xw > yw:
+        parts.append(x[..., yw:, :])
+    return jnp.concatenate(parts, axis=-2)
+
+
+def _u_xi(pair, site: str):
+    """xi * (x0 + x1 u) = (x0 - x1) + (x0 + x1) u in the lazy domain."""
+    x0, x1 = pair
+    return _u_sub(x0, x1, site), x0 + x1
+
+
+def redc(t, wrap_passes: int = 6):
+    """REDC of a lazy value: non-negative limbs < 2^30, any width in
+    [64, _UW], value < ~2^778. Identical algorithm to :func:`mont_mul`'s
+    tail; ``wrap_passes`` = 6 covers value bounds to 2^778 (worst-case
+    chain 2^778 -> r < 2^394 -> Σhi <= 1261 -> 181p -> 26p -> 4p -> 1p
+    -> < 2^384, each pass shrinking by ~p/2^384 ≈ 1/7; the final pass
+    provably zeroes the carry limb so the [:32] truncation is exact —
+    the reduce_light 3-pass lesson applied at this scale)."""
+    t = _fold(t, rounds=3, grow=True)              # limbs <= MASK+1
+    m = _conv(t[..., :NLIMBS, :], jnp.broadcast_to(
+        _crow("NPRIME"), t.shape[:-2] + (NLIMBS, t.shape[-1])), NLIMBS)
+    m = _fold_drop(m, rounds=3)                    # ≡ T*(-p^-1) mod R
+    u = _conv(m, jnp.broadcast_to(
+        _crow("P"), m.shape[:-2] + (NLIMBS, m.shape[-1])), 2 * NLIMBS)
+    u = _u_pad(u, t.shape[-2]) + t                 # ≡ 0 mod R
+    u = _fold(u, rounds=3, grow=True)              # limbs <= MASK+1
+    k = jnp.any(u[..., :NLIMBS, :] != 0, axis=-2).astype(DTYPE)
+    hi = u[..., NLIMBS:, :]
+    r = jnp.concatenate([hi[..., :1, :] + k[..., None, :], hi[..., 1:, :]],
+                        axis=-2)
+    return _wrap(_fold(r, rounds=1, grow=False), passes=wrap_passes)
+
+
+def _f2_mul_core(a, b):
+    """Unreduced Karatsuba f2 product: (T0, T1) lazy pair, width _UW,
+    limbs <= 2^18.1, value <= 2^770 (redc(T_i) = Montgomery product
+    coefficients). Inputs engine-invariant."""
+    a0, a1 = a[..., 0, :, :], a[..., 1, :, :]
+    b0, b1 = b[..., 0, :, :], b[..., 1, :, :]
+    pa = jnp.stack([a0, a1, add(a0, a1)], axis=-3)
+    pb = jnp.stack([b0, b1, add(b0, b1)], axis=-3)
+    w = _conv_tree(pa, pb, 2 * NLIMBS)     # limbs <= 2^29.01, val <= 2^768.1
+    w0, w1, w2 = w[..., 0, :, :], w[..., 1, :, :], w[..., 2, :, :]
+    # t0 = a0b0 - a1b1: sub <= 2^30.02 limbs / 2^769.3 value, fold ->
+    # <= 2^18.1 / width 65
+    t0 = _u_fold1(_u_sub(w0, w1, "C"))
+    # t1 = (a0+a1)(b0+b1) - a0b0 - a1b1: two chained "C" subs with a
+    # fold between (2^30.03 peak), value <= 2^770
+    t1 = _u_fold1(_u_sub(_u_fold1(_u_sub(w2, w0, "C")), w1, "C"))
+    return _u_pad(t0, _UW), _u_pad(t1, _UW)
+
+
+def _redc_pairs(pairs):
+    """redc a list of (x0, x1) lazy f2 pairs in ONE stacked call; returns
+    the (len(pairs), ..., 2, 32, B)-shaped reduced stack."""
+    flat = [c for p in pairs for c in p]
+    r = redc(jnp.stack(flat, axis=-3))
+    n = len(pairs)
+    return r.reshape(r.shape[:-3] + (n, 2) + r.shape[-2:])
+
+
+def _f6_mul_core(a, b):
+    """Unreduced f6 product: 3 lazy f2 pairs [(c0), (c1), (c2)], limbs
+    <= 2^22, value <= 2^774.2. One 18-product conv + lazy combines; no
+    REDC."""
+    a0, a1, a2 = a[..., 0, :, :, :], a[..., 1, :, :, :], a[..., 2, :, :, :]
+    b0, b1, b2 = b[..., 0, :, :, :], b[..., 1, :, :, :], b[..., 2, :, :, :]
+    pa = jnp.stack([a0, a1, a2,
+                    f2_add(a1, a2), f2_add(a0, a1), f2_add(a0, a2)], axis=-4)
+    pb = jnp.stack([b0, b1, b2,
+                    f2_add(b1, b2), f2_add(b0, b1), f2_add(b0, b2)], axis=-4)
+    T0, T1 = _f2_mul_core(pa, pb)  # (..., 6, _UW, B) each
+
+    def v(j):
+        return (T0[..., j, :, :], T1[..., j, :, :])
+
+    v0, v1, v2, m12, m01, m02 = (v(j) for j in range(6))
+
+    def uadd(x, y):
+        return (x[0] + y[0], x[1] + y[1])
+
+    def usub(x, y, site):
+        return (_u_sub(x[0], y[0], site), _u_sub(x[1], y[1], site))
+
+    def uxi(x, site):
+        return _u_xi(x, site)
+
+    # c0 = v0 + xi*(m12 - (v1+v2)):
+    #   s12 <= 2^19.1/2^771 ("S" fits); sub <= 2^20.4/2^772.5; xi at
+    #   "X" -> <= 2^21.8/2^774; + v0 -> <= 2^21.9/2^774.1
+    c0 = uadd(v0, uxi(usub(m12, uadd(v1, v2), "S"), "X"))
+    # c1 = (m01 - (v0+v1)) + xi*v2: xi at "T" (<= 2^19.7/2^772);
+    #   total <= 2^20.8/2^773
+    c1 = uadd(usub(m01, uadd(v0, v1), "S"), uxi(v2, "T"))
+    # c2 = (m02 - (v0+v2)) + v1 <= 2^20.5/2^772.6
+    c2 = uadd(usub(m02, uadd(v0, v2), "S"), v1)
+    return [c0, c1, c2]
+
+
+def _u_mul_by_v(cs, site: str):
+    """mul_by_v on a lazy f6 coefficient list: (c0,c1,c2) -> (xi*c2, c0, c1)."""
+    return [_u_xi(cs[2], site), cs[0], cs[1]]
+
+
 def select(cond, a, b):
     """cond has the batch shape of a without the (limb, B) trailing axes —
     i.e. cond shape == a.shape[:-2]."""
@@ -482,6 +693,11 @@ def f2_neg(a):
 
 
 def f2_mul(a, b):
+    if LAZY:
+        # Karatsuba with the cross-term subtractions in the lazy
+        # domain: 3 convolutions, 2 REDCs (one stacked call)
+        t0, t1 = _f2_mul_core(a, b)
+        return redc(jnp.stack([t0, t1], axis=-3))
     a0, a1 = a[..., 0, :, :], a[..., 1, :, :]
     b0, b1 = b[..., 0, :, :], b[..., 1, :, :]
     # Karatsuba: 3 Fp products in one stacked mont_mul
@@ -543,6 +759,10 @@ def f6_neg(a):
 
 
 def f6_mul(a, b):
+    if LAZY:
+        # 18 convolutions, 6 REDCs (one stacked call): the Toom-style
+        # cross combines happen in the lazy domain
+        return _redc_pairs(_f6_mul_core(a, b))
     a0, a1, a2 = a[..., 0, :, :, :], a[..., 1, :, :, :], a[..., 2, :, :, :]
     b0, b1, b2 = b[..., 0, :, :, :], b[..., 1, :, :, :], b[..., 2, :, :, :]
     pa = jnp.stack([a0, a1, a2,
@@ -588,11 +808,38 @@ def f12_one(shape_prefix, b):
     return jnp.stack([f6_one_, f6_z], axis=-5)
 
 
+def _u_prod(cs, k):
+    """Slice product k out of a stacked-core coefficient list."""
+    return [(c0[..., k, :, :], c1[..., k, :, :]) for c0, c1 in cs]
+
+
+def _u_add6(x, y):
+    return [(p[0] + q[0], p[1] + q[1]) for p, q in zip(x, y)]
+
+
+def _u_sub6(x, y, site: str):
+    return [(_u_sub(p[0], q[0], site), _u_sub(p[1], q[1], site))
+            for p, q in zip(x, y)]
+
+
 def f12_mul(a, b):
     a0, a1 = a[..., 0, :, :, :, :], a[..., 1, :, :, :, :]
     b0, b1 = b[..., 0, :, :, :, :], b[..., 1, :, :, :, :]
     pa = jnp.stack([a0, a1, f6_add(a0, a1)], axis=-5)
     pb = jnp.stack([b0, b1, f6_add(b0, b1)], axis=-5)
+    if LAZY:
+        # 54 convolutions, 12 REDCs: both Karatsuba levels combine in
+        # the lazy domain
+        cs = _f6_mul_core(pa, pb)
+        v0, v1, v2 = (_u_prod(cs, k) for k in range(3))
+        # c0 = v0 + v*v1 (xi-shift at "Y": coeffs <= 2^22/2^774.2)
+        #   -> <= 2^23.8 limbs / 2^776.2 value
+        c0 = _u_add6(v0, _u_mul_by_v(v1, "Y"))
+        # c1 = v2 - (v0+v1): "Z" (y <= 2^23.3/2^775.2)
+        #   -> <= 2^24.4 / 2^778.1
+        c1 = _u_sub6(v2, _u_add6(v0, v1), "Z")
+        r = _redc_pairs(c0 + c1)  # (..., 6, 2, 32, B)
+        return f12(r[..., :3, :, :, :], r[..., 3:, :, :, :])
     v = f6_mul(pa, pb)
     v0 = v[..., 0, :, :, :, :]
     v1 = v[..., 1, :, :, :, :]
@@ -602,6 +849,20 @@ def f12_mul(a, b):
 
 def f12_sqr(a):
     a0, a1 = a[..., 0, :, :, :, :], a[..., 1, :, :, :, :]
+    if LAZY:
+        t = f6_add(a0, a1)
+        u = f6_add(a0, f6_mul_by_v(a1))
+        pa = jnp.stack([a0, t], axis=-5)
+        pb = jnp.stack([a1, u], axis=-5)
+        cs = _f6_mul_core(pa, pb)
+        v0 = _u_prod(cs, 0)   # a0*a1
+        w = _u_prod(cs, 1)    # (a0+a1)(a0+v*a1)
+        # c0 = w - (v0 + v*v0): y <= 2^23.8/2^776.2, "Z" -> c0 <=
+        # 2^24.4 limbs / 2^778.1 value (redc wrap_passes=6 ceiling)
+        c0 = _u_sub6(w, _u_add6(v0, _u_mul_by_v(v0, "Y")), "Z")
+        c1 = _u_add6(v0, v0)
+        r = _redc_pairs(c0 + c1)
+        return f12(r[..., :3, :, :, :], r[..., 3:, :, :, :])
     v0 = f6_mul(a0, a1)
     c0 = f6_sub(f6_mul(f6_add(a0, a1), f6_add(a0, f6_mul_by_v(a1))),
                 f6_add(v0, f6_mul_by_v(v0)))

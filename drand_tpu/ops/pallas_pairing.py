@@ -39,7 +39,7 @@ from . import limb as _limb
 from .bl import (
     NLIMBS, DTYPE,
     f2, f2_add, f2_sub, f2_neg, f2_mul, f2_sqr, f2_mul_fp, f2_mul_small,
-    f2_mul_by_xi, f12_mul, f12_sqr, f12_conj, f12_inv, f12_frobenius,
+    f2_mul_by_xi, f12, f12_mul, f12_sqr, f12_conj, f12_inv, f12_frobenius,
     f12_cyclotomic_sqr, f12_one, f12_from_w, f12_to_w,
     reduce_light,
 )
@@ -47,6 +47,9 @@ from .bl import (
 # ---------------------------------------------------------------------------
 # Bit schedules (host constants, passed to kernels as inputs)
 # ---------------------------------------------------------------------------
+
+# trace-time constant (read at first kernel compile; see bl.CONV_MODE)
+PAIRFOLD = __import__("os").environ.get("DRAND_TPU_PAIRFOLD", "1") == "1"
 
 _X_ABS = abs(X_BLS)
 
@@ -141,14 +144,58 @@ def _add_step(T, q, xp, yp):
     return (Xn, Yn, Zn), (c0, c3, c5)
 
 
+def _lines_product(l0, l1):
+    """Product of two 035-sparse lines as a full f12 element.
+
+    (c0 + c3 w^3 + c5 w^5)(d0 + d3 w^3 + d5 w^5) via the 6-multiply
+    3-term Karatsuba (m0, m1, m2 plus the three pair-sum products), then
+    w-power folding with w^6 = xi: the w^6/w^8/w^10 terms land on
+    w^0/w^2/w^4 with a xi twist, leaving slot w^1 zero. 6 Fp2 muls (one
+    stacked mont_mul) instead of the naive 9."""
+    c0, c3, c5 = l0
+    d0, d3, d5 = l1
+    pa = jnp.stack([c0, c3, c5, f2_add(c0, c3), f2_add(c0, c5),
+                    f2_add(c3, c5)], axis=0)
+    pb = jnp.stack([d0, d3, d5, f2_add(d0, d3), f2_add(d0, d5),
+                    f2_add(d3, d5)], axis=0)
+    m = f2_mul(pa, pb)
+    m0, m1, m2 = m[0], m[1], m[2]
+    s03 = f2_sub(m[3], f2_add(m0, m1))   # c0d3 + c3d0 -> w^3
+    s05 = f2_sub(m[4], f2_add(m0, m2))   # c0d5 + c5d0 -> w^5
+    s35 = f2_sub(m[5], f2_add(m1, m2))   # c3d5 + c5d3 -> w^8 = xi w^2
+    e0 = f2_add(m0, f2_mul_by_xi(m1))    # w^0 + xi (from w^6)
+    e2 = f2_mul_by_xi(s35)
+    e4 = f2_mul_by_xi(m2)                # w^10 = xi w^4
+    cL0 = jnp.stack([e0, e2, e4], axis=-4)             # w^0, w^2, w^4
+    cL1 = jnp.stack([jnp.zeros_like(e0), s03, s05], axis=-4)  # w^1,3,5
+    return f12(cL0, cL1)
+
+
 def _sparse_mul_035(f, lines, npairs: int, split: bool = False):
-    """f * L_j for per-pair lines L = c0 + c3*w^3 + c5*w^5, folded in
-    sequentially (slots from the M-twist untwist — see
-    ops/pairing._sparse_mul_035). ``split`` computes the three
-    coefficient products as separate f2_muls instead of one stacked one —
-    ~3x smaller peak temporaries, used inside VMEM-bounded kernels."""
+    """f * prod_j L_j for per-pair lines L_j = c0 + c3*w^3 + c5*w^5
+    (slots from the M-twist untwist — see ops/pairing._sparse_mul_035).
+
+    Lines are folded in PAIRS: L_j * L_{j+1} is formed first with
+    :func:`_lines_product` (6 Fp2 muls) and multiplied into f as one
+    full f12_mul (18 Fp2 muls) — 24 Fp2 muls per line pair with NO
+    w-basis round trip of f, versus 36 Fp2 muls plus two to_w/from_w
+    shuffles for the sequential per-line fold (kept below for an odd
+    trailing line). ``split`` shrinks peak temporaries on that odd-line
+    path only.
+
+    VMEM note: the pair fold's peak temporaries inside the Miller
+    kernels match a BB-batch f12_mul (~the pow kernels' working set,
+    proven on-chip); set DRAND_TPU_PAIRFOLD=0 (trace-time constant,
+    like DRAND_TPU_CONV) to A/B or fall back to the sequential fold if
+    a Mosaic VMEM limit is hit at some batch shape."""
     c0, c3, c5 = lines  # each (NP, 2, 32, B)
-    for j in range(npairs):
+    j = 0
+    while PAIRFOLD and j + 1 < npairs:
+        L = _lines_product((c0[j], c3[j], c5[j]),
+                           (c0[j + 1], c3[j + 1], c5[j + 1]))
+        f = f12_mul(f, L)
+        j += 2
+    for j in range(j, npairs):
         fw = f12_to_w(f)  # (6, 2, 32, B)
         if split:
             p0 = f2_mul(fw, c0[j][None])

@@ -1,0 +1,141 @@
+"""Lazy-reduction domain (ops/bl.py LAZY path) — value-level goldens.
+
+The lazy path accumulates unreduced product convolutions and REDCs once
+per output coefficient (f2_mul 3->2, f6_mul 18->6, f12_mul 54->12
+REDCs). Its soundness rests on static per-site bounds (limb < 2^31
+everywhere, redc input < 2^30 limbs / ~2^778.5 value with wrap_passes=6)
+— the probes here are the ones the round-3 reduce_light bug taught us:
+content-varied batches, CHAINED non-canonical values, and max-limb
+adversarial inputs, all against the host tower (crypto/fields).
+
+Reference parity: kyber-bls12381's backend performs the same
+BLST-style lazy Fp2 accumulation in assembly (/root/reference/go.mod:9).
+"""
+
+import random
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from drand_tpu.crypto import fields as hf
+from drand_tpu.ops import bl, limb as _x
+
+P = hf.P
+RINV = pow(_x.R_MONT, -1, P)
+rng = random.Random(0xA55)
+
+pytestmark = pytest.mark.skipif(not bl.LAZY, reason="lazy path disabled")
+
+
+def pack2(vals):
+    return np.stack([bl.pack_fp([v[0] for v in vals]),
+                     bl.pack_fp([v[1] for v in vals])], axis=0)
+
+
+def rand2():
+    return hf.Fp2(rng.randrange(P), rng.randrange(P))
+
+
+def rand6():
+    return hf.Fp6(rand2(), rand2(), rand2())
+
+
+def rand12():
+    return hf.Fp12(rand6(), rand6())
+
+
+def pack6(vals):
+    return np.stack([pack2([(v.c0.c0, v.c0.c1) for v in vals]),
+                     pack2([(v.c1.c0, v.c1.c1) for v in vals]),
+                     pack2([(v.c2.c0, v.c2.c1) for v in vals])], axis=0)
+
+
+def pack12(vals):
+    return np.stack([pack6([v.c0 for v in vals]),
+                     pack6([v.c1 for v in vals])], axis=0)
+
+
+def unpack12(r, i):
+    out = []
+    for h in range(2):
+        for ci in range(3):
+            out.append((bl.unpack_fp(np.asarray(r[h, ci, 0]))[i],
+                        bl.unpack_fp(np.asarray(r[h, ci, 1]))[i]))
+    return out
+
+
+def flat12(e):
+    return [(c.c0, c.c1) for half in (e.c0, e.c1)
+            for c in (half.c0, half.c1, half.c2)]
+
+
+B = 4
+
+
+def test_f2_mul_lazy_matches_host():
+    a = [rand2() for _ in range(B)]
+    b = [rand2() for _ in range(B)]
+    r = bl.f2_mul(jnp.asarray(pack2([(x.c0, x.c1) for x in a])),
+                  jnp.asarray(pack2([(x.c0, x.c1) for x in b])))
+    for i in range(B):
+        e = a[i] * b[i]
+        assert bl.unpack_fp(np.asarray(r[0]))[i] == e.c0
+        assert bl.unpack_fp(np.asarray(r[1]))[i] == e.c1
+
+
+def test_f6_f12_mul_lazy_match_host():
+    a6, b6 = [rand6() for _ in range(B)], [rand6() for _ in range(B)]
+    r = bl.f6_mul(jnp.asarray(pack6(a6)), jnp.asarray(pack6(b6)))
+    for i in range(B):
+        e = a6[i] * b6[i]
+        for ci, ec in enumerate([e.c0, e.c1, e.c2]):
+            assert bl.unpack_fp(np.asarray(r[ci, 0]))[i] == ec.c0
+            assert bl.unpack_fp(np.asarray(r[ci, 1]))[i] == ec.c1
+    a12, b12 = [rand12() for _ in range(B)], [rand12() for _ in range(B)]
+    r = bl.f12_mul(jnp.asarray(pack12(a12)), jnp.asarray(pack12(b12)))
+    for i in range(B):
+        assert unpack12(r, i) == flat12(a12[i] * b12[i])
+    r = bl.f12_sqr(jnp.asarray(pack12(a12)))
+    for i in range(B):
+        assert unpack12(r, i) == flat12(a12[i] * a12[i])
+
+
+def test_lazy_chained_non_canonical():
+    """Repeated lazy muls feed the engine's lazy-carry outputs back in —
+    the probe class that caught the round-3 reduce_light truncation."""
+    a12 = [rand12() for _ in range(B)]
+    x_d = jnp.asarray(pack12(a12))
+    x_h = list(a12)
+    for step in range(8):
+        x_d = bl.f12_mul(x_d, x_d) if step % 2 == 0 else bl.f12_sqr(x_d)
+        x_h = [v * v for v in x_h]
+        for i in range(B):
+            assert unpack12(x_d, i) == flat12(x_h[i]), (step, i)
+
+
+def test_lazy_max_limb_adversarial():
+    """All limbs at the 4100 engine-invariant ceiling — the worst case
+    for every conv coefficient and complement bound simultaneously."""
+    mx12 = np.full((2, 3, 2, 32, B), 4100, np.int32)
+    vmax = _x.limbs_to_int(np.full(32, 4100)) % P
+    c = vmax * RINV % P
+    cf2 = hf.Fp2(c, c)
+    cf6 = hf.Fp6(cf2, cf2, cf2)
+    e = hf.Fp12(cf6, cf6) * hf.Fp12(cf6, cf6)
+    r = bl.f12_mul(jnp.asarray(mx12), jnp.asarray(mx12))
+    for i in range(B):
+        assert unpack12(r, i) == flat12(e)
+
+
+def test_redc_magnitude_ceiling():
+    """redc stays exact through the documented 2^778.5 value ceiling."""
+    for vbits in (769, 774, 778):
+        for _ in range(10):
+            lim = np.asarray(
+                [rng.randrange(min(1 << 24, (1 << max(0, vbits - 12 * k))))
+                 if 12 * k <= vbits else 0 for k in range(66)], np.int32)
+            t = jnp.asarray(np.stack([lim, lim], axis=-1))
+            val = _x.limbs_to_int(lim)
+            got = bl.unpack_fp(np.asarray(bl.redc(t)))[0]
+            assert got == val * RINV % P * RINV % P, vbits
