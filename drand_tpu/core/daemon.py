@@ -62,6 +62,7 @@ class Drand(ProtocolService):
         self.beacon: Handler | None = None
         # DKG-in-progress state
         self._setup_mgr: SetupManager | None = None
+        self._setup_token: object | None = None  # whole-flow setup slot
         self._board: BroadcastBoard | None = None
         # bundles that raced ahead of board creation (a dealer can push its
         # deals before a follower finished processing the group push);
@@ -98,67 +99,113 @@ class Drand(ProtocolService):
         return d
 
     # ----------------------------------------------------- control plane
+    def _acquire_setup(self, force: bool) -> object:
+        """Claim the single setup/DKG slot for a WHOLE init flow
+        (drand_control.go:41 'force' flag): a second InitDKG/InitReshare
+        errors unless forced; force cancels a setup still collecting
+        participants but cannot abort a DKG already running."""
+        if self._setup_token is not None:
+            if not force:
+                raise DrandError(
+                    "a setup phase is already in progress (pass force "
+                    "to preempt it)")
+            if self._setup_mgr is None:
+                raise DrandError(
+                    "cannot preempt: the DKG phase is already running")
+            self._setup_mgr.cancel()
+        token = object()
+        self._setup_token = token
+        return token
+
+    def _release_setup(self, token: object) -> None:
+        # a forced successor may already own the slot — release only ours
+        if self._setup_token is token:
+            self._setup_token = None
+
+    def _begin_setup(self, sc: SetupConfig) -> SetupManager:
+        mgr = SetupManager(sc, self.priv.public, self.conf.clock,
+                           self._l.named("setup"))
+        self._setup_mgr = mgr
+        return mgr
+
+    async def _wait_setup(self, mgr: SetupManager, timeout: float):
+        try:
+            return await mgr.wait_participants(timeout)
+        finally:
+            # a forced successor may already have installed ITS manager —
+            # only clear our own
+            if self._setup_mgr is mgr:
+                self._setup_mgr = None
+
     async def init_dkg_leader(self, expected_n: int, threshold: int,
                               period: int, secret: bytes,
                               timeout: float = 60.0,
-                              catchup_period: int = 0) -> Group:
+                              catchup_period: int = 0,
+                              force: bool = False) -> Group:
         """Leader: collect participants, push the group, run the DKG,
         start the beacon (InitDKG :33 + leaderRunSetup :72)."""
         sc = SetupConfig(expected_n=expected_n, threshold=threshold,
                          period=period, secret=secret,
                          catchup_period=catchup_period,
                          dkg_timeout=self.conf.dkg_timeout)
-        self._setup_mgr = SetupManager(sc, self.priv.public, self.conf.clock,
-                                       self._l.named("setup"))
+        token = self._acquire_setup(force)
         try:
-            idents = await self._setup_mgr.wait_participants(timeout)
+            mgr = self._begin_setup(sc)
+            idents = await self._wait_setup(mgr, timeout)
+            group = mgr.make_group(idents)
+            await self._push_group(group, secret)
+            result = await self._run_dkg(group)
+            return await self._adopt_dkg_output(group, result, fresh=True)
         finally:
-            mgr, self._setup_mgr = self._setup_mgr, None
-        group = mgr.make_group(idents)
-        await self._push_group(group, secret)
-        result = await self._run_dkg(group)
-        return await self._adopt_dkg_output(group, result, fresh=True)
+            self._release_setup(token)
 
     async def init_dkg_follower(self, leader: Node | str, secret: bytes,
-                                timeout: float = 60.0) -> Group:
+                                timeout: float = 60.0,
+                                force: bool = False) -> Group:
         """Follower: signal the leader, await the signed group, run the DKG
         (setupAutomaticDKG :291)."""
-        self._expected_secret = secret
-        self._group_packet = asyncio.get_event_loop().create_future()
-        await self._signal_leader(leader, secret, b"", timeout)
-        packet, leader_ident = await asyncio.wait_for(
-            self._group_packet, timeout)
-        group = verify_group_packet(leader_ident, packet)
-        if group.find(self.priv.public) is None:
-            raise DrandError("we are not part of the pushed group")
-        result = await self._run_dkg(group)
-        return await self._adopt_dkg_output(group, result, fresh=True)
+        token = self._acquire_setup(force)
+        try:
+            self._expected_secret = secret
+            self._group_packet = asyncio.get_event_loop().create_future()
+            await self._signal_leader(leader, secret, b"", timeout)
+            packet, leader_ident = await asyncio.wait_for(
+                self._group_packet, timeout)
+            group = verify_group_packet(leader_ident, packet)
+            if group.find(self.priv.public) is None:
+                raise DrandError("we are not part of the pushed group")
+            result = await self._run_dkg(group)
+            return await self._adopt_dkg_output(group, result, fresh=True)
+        finally:
+            self._release_setup(token)
 
     async def init_reshare_leader(self, expected_n: int, threshold: int,
-                                  secret: bytes, timeout: float = 60.0) -> Group:
+                                  secret: bytes, timeout: float = 60.0,
+                                  force: bool = False) -> Group:
         """Leader of a resharing epoch; must hold the old group+share
         (InitReshare :500)."""
         old_group, old_share = self._require_running()
         sc = SetupConfig(expected_n=expected_n, threshold=threshold,
                          period=old_group.period, secret=secret,
                          dkg_timeout=self.conf.dkg_timeout)
-        self._setup_mgr = SetupManager(sc, self.priv.public, self.conf.clock,
-                                       self._l.named("setup"))
+        token = self._acquire_setup(force)
         try:
-            idents = await self._setup_mgr.wait_participants(timeout)
+            mgr = self._begin_setup(sc)
+            idents = await self._wait_setup(mgr, timeout)
+            group = mgr.make_group(idents, old_group=old_group)
+            # push to the union of old and new members so leavers learn too
+            await self._push_group(group, secret, extra=old_group.nodes)
+            result = await self._run_dkg(group, old_group=old_group,
+                                         old_share=old_share)
+            return await self._transition(old_group, group, result)
         finally:
-            mgr, self._setup_mgr = self._setup_mgr, None
-        group = mgr.make_group(idents, old_group=old_group)
-        # push to the union of old and new members so leavers learn too
-        await self._push_group(group, secret, extra=old_group.nodes)
-        result = await self._run_dkg(group, old_group=old_group,
-                                     old_share=old_share)
-        return await self._transition(old_group, group, result)
+            self._release_setup(token)
 
     async def init_reshare_follower(self, leader: Node | str, secret: bytes,
                                     old_group: Group | None = None,
                                     leaving: bool = False,
-                                    timeout: float = 60.0) -> Group:
+                                    timeout: float = 60.0,
+                                    force: bool = False) -> Group:
         """Existing member, new joiner, or leaver in a resharing epoch
         (setupAutomaticResharing :371). New joiners pass the old group file
         (they need its public coefficients); members use their stored one.
@@ -168,18 +215,23 @@ class Drand(ProtocolService):
             old_group = self.group
         if old_group is None:
             raise DrandError("resharing needs the old group file")
-        self._expected_secret = secret
-        self._group_packet = asyncio.get_event_loop().create_future()
-        if not leaving:
-            await self._signal_leader(leader, secret, old_group.hash(), timeout)
-        packet, leader_ident = await asyncio.wait_for(
-            self._group_packet, timeout)
-        group = verify_group_packet(leader_ident, packet)
-        if old_group.find(leader_ident) is None:
-            raise DrandError("reshare leader not part of the old group")
-        result = await self._run_dkg(group, old_group=old_group,
-                                     old_share=self.share)
-        return await self._transition(old_group, group, result)
+        token = self._acquire_setup(force)
+        try:
+            self._expected_secret = secret
+            self._group_packet = asyncio.get_event_loop().create_future()
+            if not leaving:
+                await self._signal_leader(leader, secret, old_group.hash(),
+                                          timeout)
+            packet, leader_ident = await asyncio.wait_for(
+                self._group_packet, timeout)
+            group = verify_group_packet(leader_ident, packet)
+            if old_group.find(leader_ident) is None:
+                raise DrandError("reshare leader not part of the old group")
+            result = await self._run_dkg(group, old_group=old_group,
+                                         old_share=self.share)
+            return await self._transition(old_group, group, result)
+        finally:
+            self._release_setup(token)
 
     def start_beacon(self, catchup: bool = True) -> None:
         """Boot the beacon from persisted state (core/drand.go:220)."""

@@ -48,6 +48,10 @@ class SetupConfig:
     genesis_offset: int = DEFAULT_GENESIS_OFFSET
 
 
+class SetupPreempted(RuntimeError):
+    """A forced second setup cancelled this one (control.proto force)."""
+
+
 class SetupManager:
     """Leader-side participant collection (one setup at a time)."""
 
@@ -74,6 +78,10 @@ class SetupManager:
         if len(self._identities) == self.conf.expected_n and \
                 not self._done.done():
             self._done.set_result(None)
+
+    def cancel(self, reason: str = "setup preempted by a forced restart"):
+        if not self._done.done():
+            self._done.set_exception(SetupPreempted(reason))
 
     async def wait_participants(self, timeout: float) -> list[Identity]:
         try:

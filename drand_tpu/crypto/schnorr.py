@@ -4,8 +4,14 @@ The reference's DKGAuthScheme (key/curve.go:38): authenticates DKG broadcast
 packets (core/broadcast.go via dkg.VerifyPacketSignature) and the leader's
 signed group file (core/drand_control.go:714, core/group_setup.go:329).
 
-sig = R_bytes || s_bytes with R = k*G1, s = k + H(R || pub || msg)*sk.
-Challenge hash is SHA-256 reduced into Fr.
+sig = R_bytes || s_bytes with R = k*G1, s = k + H(R || pub || msg)*sk —
+kyber sign/schnorr's layout: the challenge is SHA-512 over
+(R.MarshalBinary() || pub.MarshalBinary() || msg) reduced big-endian
+into Fr (kyber schnorr.go hash() with the bls12381 suite's mod-r
+scalar), so DKG packet and group-push signatures verify across a
+reference<->drand-tpu boundary. Kyber sources are absent from this
+image; the layout is reproduced from the documented schnorr.go and
+pinned by vectors in tests/test_schnorr.py.
 """
 
 from __future__ import annotations
@@ -20,11 +26,13 @@ SIG_SIZE = PointG1.COMPRESSED_SIZE + 32  # 80 bytes
 
 
 def _challenge(big_r: PointG1, pub: PointG1, msg: bytes) -> int:
-    h = hashlib.sha256()
+    # kyber schnorr.go hash(): sha512(R || public || msg), scalar set
+    # big-endian reduced mod r
+    h = hashlib.sha512()
     h.update(big_r.to_bytes())
     h.update(pub.to_bytes())
     h.update(msg)
-    return fr_from_bytes_wide(h.digest())
+    return int.from_bytes(h.digest(), "big") % R
 
 
 def _nonce(sk: int, msg: bytes) -> int:

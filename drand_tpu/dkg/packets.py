@@ -4,9 +4,20 @@ Mirrors the reference's protobuf DKG packet shapes
 (protobuf/crypto/dkg/dkg.proto:14-93, converted at core/convert.go:24) and
 kyber's bundle semantics: every bundle carries the issuer's index, a session
 nonce, and a signature over the bundle's canonical hash (verified on ingress
-— core/broadcast.go:53 `dkg.VerifyPacketSignature` analogue).
+— core/broadcast.go:98 `BroadcastDKG` -> core/drand_control.go:139
+`dkg.VerifyPacketSignature` analogue).
 
-Canonical encoding: length-prefixed concatenation; hashes are blake2b-256.
+Canonical hashes follow KYBER'S layout (drand/kyber share/dkg/structs.go
+``DealBundle.Hash``/``ResponseBundle.Hash``/``JustificationBundle.Hash``)
+so a drand-tpu node's DKG signatures verify under a reference node's
+`VerifyPacketSignature` and vice versa: SHA-256; uint32 big-endian
+indices; entries sorted by their index; deal ciphertexts / compressed
+commitment points / 32-byte big-endian scalars written raw (no length
+prefixes, no domain tags); session id written last. The kyber sources
+are not present in this image, so the layout is reproduced from the
+documented structs.go implementation and pinned by golden vectors in
+tests/test_dkg_packets.py — any byte-order fix needed against a live
+kyber peer is localized to the three hash() methods below.
 """
 
 from __future__ import annotations
@@ -54,14 +65,17 @@ class DealBundle:
     signature: bytes = b""      # schnorr by the dealer's longterm key
 
     def hash(self) -> bytes:
-        h = hashlib.blake2b(digest_size=32)
-        h.update(b"dkg-deal")
-        h.update(_u16(self.dealer_index))
+        # kyber structs.go DealBundle.Hash: sha256(dealer_index_u32be ||
+        # (share_index_u32be || ciphertext)* sorted by share index ||
+        # compressed commit points || session_id)
+        h = hashlib.sha256()
+        h.update(_u32(self.dealer_index))
+        for d in sorted(self.deals, key=lambda d: d.share_index):
+            h.update(_u32(d.share_index))
+            h.update(d.encrypted_share)
         for c in self.commits:
             h.update(c)
-        for d in self.deals:
-            h.update(d.encode())
-        h.update(_blob(self.session_id))
+        h.update(self.session_id)
         return h.digest()
 
     def commit_points(self) -> list[PointG1]:
@@ -87,12 +101,15 @@ class ResponseBundle:
     signature: bytes = b""
 
     def hash(self) -> bytes:
-        h = hashlib.blake2b(digest_size=32)
-        h.update(b"dkg-response")
-        h.update(_u16(self.share_index))
-        for r in self.responses:
-            h.update(r.encode())
-        h.update(_blob(self.session_id))
+        # kyber structs.go ResponseBundle.Hash: sha256(share_index_u32be
+        # || (dealer_index_u32be || status_byte)* sorted by dealer index
+        # || session_id)
+        h = hashlib.sha256()
+        h.update(_u32(self.share_index))
+        for r in sorted(self.responses, key=lambda r: r.dealer_index):
+            h.update(_u32(r.dealer_index))
+            h.update(bytes([1 if r.status == STATUS_APPROVAL else 0]))
+        h.update(self.session_id)
         return h.digest()
 
 
@@ -115,12 +132,15 @@ class JustificationBundle:
     signature: bytes = b""
 
     def hash(self) -> bytes:
-        h = hashlib.blake2b(digest_size=32)
-        h.update(b"dkg-justification")
-        h.update(_u16(self.dealer_index))
-        for j in self.justifications:
-            h.update(j.encode())
-        h.update(_blob(self.session_id))
+        # kyber structs.go JustificationBundle.Hash: sha256(
+        # dealer_index_u32be || (share_index_u32be || scalar_32be)*
+        # sorted by share index || session_id)
+        h = hashlib.sha256()
+        h.update(_u32(self.dealer_index))
+        for j in sorted(self.justifications, key=lambda j: j.share_index):
+            h.update(_u32(j.share_index))
+            h.update(j.share.to_bytes(32, "big"))
+        h.update(self.session_id)
         return h.digest()
 
 

@@ -106,6 +106,61 @@ class Group:
             d["public_key"] = [c.to_bytes().hex() for c in self.public_key.coefficients]
         return d
 
+    def to_proto_dict(self) -> dict:
+        """common.proto GroupPacket field dict (key/group.go GroupToProto
+        analogue) — encodable with protowire.GROUP_PACKET."""
+        d = {
+            "nodes": [{
+                "public": {
+                    "address": n.identity.addr,
+                    "key": n.identity.key.to_bytes(),
+                    "tls": n.identity.tls,
+                    "signature": n.identity.signature,
+                },
+                "index": n.index,
+            } for n in self.nodes],
+            "threshold": self.threshold,
+            "period": self.period,
+            "genesis_time": self.genesis_time,
+            "transition_time": self.transition_time,
+            "genesis_seed": self.get_genesis_seed(),
+            "catchup_period": self.catchup_period,
+            "dist_key": [],
+        }
+        if self.public_key is not None:
+            d["dist_key"] = [c.to_bytes()
+                             for c in self.public_key.coefficients]
+        return d
+
+    @staticmethod
+    def from_proto_dict(d: dict) -> "Group":
+        """Inverse of :meth:`to_proto_dict` (key/group.go:317
+        GroupFromProto analogue)."""
+        from ..crypto.curves import PointG1
+
+        nodes = [
+            Node(identity=Identity(
+                key=PointG1.from_bytes(nd["public"]["key"]),
+                addr=nd["public"]["address"],
+                tls=bool(nd["public"].get("tls", False)),
+                signature=nd["public"].get("signature", b"")),
+                index=nd["index"])
+            for nd in d.get("nodes", [])
+        ]
+        pk = None
+        if d.get("dist_key"):
+            pk = DistPublic([PointG1.from_bytes(c) for c in d["dist_key"]])
+        return Group(
+            nodes=nodes,
+            threshold=d["threshold"],
+            period=d["period"],
+            genesis_time=d.get("genesis_time", 0),
+            genesis_seed=d.get("genesis_seed", b""),
+            transition_time=d.get("transition_time", 0),
+            catchup_period=d.get("catchup_period", 0),
+            public_key=pk,
+        )
+
     @staticmethod
     def from_dict(d: dict) -> "Group":
         from ..crypto.curves import PointG1

@@ -2,27 +2,50 @@
 
 Reference: net/control.go (ControlListener :17, ControlClient :48) and
 protobuf/drand/control.proto:14-37 (PingPong, InitDKG, InitReshare,
-PublicKey, ChainInfo, GroupFile, Shutdown, StartFollowChain). The CLI
-(`python -m drand_tpu.cli`) talks to a running daemon exclusively through
-this port, like `drand` does.
+Share, PublicKey, PrivateKey, ChainInfo, GroupFile, Shutdown,
+StartFollowChain). The CLI (`python -m drand_tpu.cli`) talks to a
+running daemon exclusively through this port, like `drand` does.
 
-Payloads are plain JSON (operator plane, localhost only — the node<->node
-plane uses wire.py envelopes).
+DUAL CODEC (localhost operator plane): the native CLI speaks JSON
+envelopes; every reference method ALSO accepts/returns control.proto
+protobuf framing on the standard /drand.Control/* names, so reference
+operator tooling (`drand share/stop/show` pointed at our control port)
+interoperates. Codec detection: the native client always sends a JSON
+object (at least ``{}``), so an empty or non-JSON request selects the
+protobuf codec; the response follows the request codec.
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import tomllib
 
 import grpc
 import grpc.aio
 
+from . import protowire as pw
+from ..crypto.fields import R as _FR_R
 from ..utils.logging import KVLogger, default_logger
 
 SERVICE = "drand.Control"
 _METHODS = ("Ping", "InitDKG", "InitReshare", "PublicKey", "GroupFile",
-            "ChainInfo", "Status", "Shutdown", "Follow")
+            "ChainInfo", "Status", "Shutdown", "Follow",
+            # reference-only method names (protobuf codec)
+            "PingPong", "Share", "PrivateKey")
+
+# control.proto request/response specs per reference method name
+_PROTO_SPECS = {
+    "PingPong": (pw.EMPTY, pw.EMPTY),
+    "InitDKG": (pw.INIT_DKG_PACKET, pw.GROUP_PACKET),
+    "InitReshare": (pw.INIT_RESHARE_PACKET, pw.GROUP_PACKET),
+    "Share": (pw.SHARE_REQUEST, pw.SHARE_RESPONSE),
+    "PublicKey": (pw.PUBLIC_KEY_REQUEST, pw.PUBLIC_KEY_RESPONSE),
+    "PrivateKey": (pw.PRIVATE_KEY_REQUEST, pw.PRIVATE_KEY_RESPONSE),
+    "ChainInfo": (pw.CHAIN_INFO_REQUEST, pw.CHAIN_INFO_PACKET),
+    "GroupFile": (pw.GROUP_REQUEST, pw.GROUP_PACKET),
+    "Shutdown": (pw.SHUTDOWN_REQUEST, pw.SHUTDOWN_RESPONSE),
+}
 
 
 class ControlServer:
@@ -40,6 +63,9 @@ class ControlServer:
             name: grpc.unary_unary_rpc_method_handler(self._dispatch(name))
             for name in _METHODS
         }
+        # control.proto:37 — server-streaming follow with progress frames
+        handlers["StartFollowChain"] = grpc.unary_stream_rpc_method_handler(
+            self._start_follow_chain)
         server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(SERVICE, handlers),))
         self.port = server.add_insecure_port(f"127.0.0.1:{self._port}")
@@ -56,17 +82,159 @@ class ControlServer:
         await self._shutdown_event.wait()
 
     def _dispatch(self, name: str):
-        method = getattr(self, f"_{name.lower()}")
+        native_method = getattr(self, f"_{name.lower()}", None)
+        specs = _PROTO_SPECS.get(name)
 
         async def handler(request: bytes, context) -> bytes:
+            req = None
+            if request and native_method is not None:
+                try:
+                    req = json.loads(request)
+                except (ValueError, UnicodeDecodeError):
+                    req = None
+            if req is not None:
+                try:
+                    return json.dumps(await native_method(req)).encode()
+                except Exception as e:  # noqa: BLE001 — operator plane
+                    await context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                                        f"{type(e).__name__}: {e}")
+                    return b""
+            # protobuf codec (reference tooling)
+            if specs is None:
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                    f"{name}: JSON payload expected")
+                return b""
+            req_spec, resp_spec = specs
             try:
-                req = json.loads(request) if request else {}
-                resp = await method(req)
-                return json.dumps(resp).encode()
+                preq = pw.decode(req_spec, request)
+            except pw.WireError as e:
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                return b""
+            try:
+                resp = await self._proto_call(name, preq)
+                return pw.encode(resp_spec, resp)
             except Exception as e:  # noqa: BLE001 — operator plane
                 await context.abort(grpc.StatusCode.FAILED_PRECONDITION,
                                     f"{type(e).__name__}: {e}")
+                return b""
         return handler
+
+    async def _proto_call(self, name: str, req: dict) -> dict:
+        """control.proto semantics on the daemon (core/drand_control.go)."""
+        d = self._d
+        if name == "PingPong":
+            return {}
+        if name == "InitDKG":
+            info = req.get("info") or {}
+            timeout = float(info.get("timeout") or 60)
+            if info.get("leader"):
+                group = await d.init_dkg_leader(
+                    expected_n=int(info.get("nodes") or 0),
+                    threshold=int(info.get("threshold") or 0),
+                    period=int(req.get("beacon_period") or 30),
+                    secret=info.get("secret") or b"",
+                    timeout=timeout,
+                    catchup_period=int(req.get("catchup_period") or 0),
+                    force=bool(info.get("force")))
+            else:
+                group = await d.init_dkg_follower(
+                    leader=info.get("leader_address") or "",
+                    secret=info.get("secret") or b"", timeout=timeout)
+            return group.to_proto_dict()
+        if name == "InitReshare":
+            info = req.get("info") or {}
+            timeout = float(info.get("timeout") or 60)
+            if info.get("leader"):
+                group = await d.init_reshare_leader(
+                    expected_n=int(info.get("nodes") or 0),
+                    threshold=int(info.get("threshold") or 0),
+                    secret=info.get("secret") or b"", timeout=timeout,
+                    force=bool(info.get("force")))
+            else:
+                old_group = None
+                loc = req.get("old") or {}
+                if loc.get("path"):
+                    from ..key.group import Group
+
+                    with open(loc["path"], "rb") as f:
+                        old_group = Group.from_dict(tomllib.load(f))
+                group = await d.init_reshare_follower(
+                    leader=info.get("leader_address") or "",
+                    secret=info.get("secret") or b"",
+                    old_group=old_group, timeout=timeout)
+            return group.to_proto_dict()
+        if name == "Share":
+            if d.share is None:
+                raise RuntimeError("no share loaded")
+            ps = d.share.pri_share
+            return {"index": ps.index,
+                    "share": (ps.value % _FR_R).to_bytes(32, "big")}
+        if name == "PublicKey":
+            return {"pub_key": d.priv.public.key.to_bytes()}
+        if name == "PrivateKey":
+            return {"pri_key": (d.priv.key % _FR_R).to_bytes(32, "big")}
+        if name == "ChainInfo":
+            info = await d.chain_info("control")
+            return {"public_key": info.public_key.to_bytes(),
+                    "period": info.period,
+                    "genesis_time": info.genesis_time,
+                    "hash": info.hash(),
+                    "group_hash": info.group_hash}
+        if name == "GroupFile":
+            if d.group is None:
+                raise RuntimeError("no group loaded")
+            return d.group.to_proto_dict()
+        if name == "Shutdown":
+            self._d.stop()
+            self._shutdown_event.set()
+            return {}
+        raise RuntimeError(f"unhandled proto method {name}")
+
+    async def _start_follow_chain(self, request: bytes, context):
+        """control.proto:37 StartFollowChain — protobuf server-streaming
+        follow with FollowProgress frames (core/drand_control.go:783)."""
+        try:
+            req = pw.decode(pw.START_FOLLOW_REQUEST, request)
+        except pw.WireError as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            return
+        peers = list(req.get("nodes") or [])
+        up_to = int(req.get("up_to") or 0)
+
+        def last_round() -> int:
+            # progress of the FOLLOW sync itself (daemon._follow_store),
+            # not the daemon's own beacon — the endpoint's use case is a
+            # non-member node with no beacon at all
+            store = getattr(self._d, "_follow_store", None)
+            if store is None:
+                return 0
+            try:
+                return store.last().round
+            except Exception:  # noqa: BLE001 — store may still be empty
+                return 0
+
+        self._d._follow_store = None  # don't report a previous follow
+        task = asyncio.ensure_future(self._d.follow_chain(peers, up_to))
+        try:
+            while not task.done():
+                yield pw.encode(pw.FOLLOW_PROGRESS,
+                                {"current": last_round(), "target": up_to})
+                await asyncio.wait({task}, timeout=1.0)
+            try:
+                ok = task.result()
+            except Exception as e:  # noqa: BLE001 — surface as status
+                await context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                                    f"{type(e).__name__}: {e}")
+                return
+            if not ok:
+                await context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                                    "follow failed on all peers")
+                return
+            yield pw.encode(pw.FOLLOW_PROGRESS,
+                            {"current": last_round(), "target": up_to})
+        finally:
+            if not task.done():
+                task.cancel()
 
     # ------------------------------------------------------------ methods
     async def _ping(self, req: dict) -> dict:
@@ -79,7 +247,8 @@ class ControlServer:
                 period=int(req["period"]),
                 secret=bytes.fromhex(req["secret"]),
                 timeout=float(req.get("timeout", 60.0)),
-                catchup_period=int(req.get("catchup_period", 0)))
+                catchup_period=int(req.get("catchup_period", 0)),
+                force=bool(req.get("force", False)))
         else:
             group = await self._d.init_dkg_follower(
                 leader=req["connect"], secret=bytes.fromhex(req["secret"]),
@@ -91,7 +260,8 @@ class ControlServer:
             group = await self._d.init_reshare_leader(
                 expected_n=int(req["nodes"]), threshold=int(req["threshold"]),
                 secret=bytes.fromhex(req["secret"]),
-                timeout=float(req.get("timeout", 60.0)))
+                timeout=float(req.get("timeout", 60.0)),
+                force=bool(req.get("force", False)))
         else:
             old_group = None
             if req.get("old_group"):

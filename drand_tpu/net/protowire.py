@@ -43,6 +43,11 @@ __all__ = [
     "PARTIAL_BEACON_PACKET", "SIGNAL_DKG_PACKET", "DKG_INFO_PACKET",
     "DKG_PACKET", "DKG_BUNDLE", "DEAL", "DEAL_BUNDLE", "RESPONSE",
     "RESPONSE_BUNDLE", "JUSTIFICATION", "JUSTIFICATION_BUNDLE",
+    "SETUP_INFO_PACKET", "ENTROPY_INFO", "INIT_DKG_PACKET", "GROUP_INFO",
+    "INIT_RESHARE_PACKET", "SHARE_REQUEST", "SHARE_RESPONSE",
+    "PUBLIC_KEY_REQUEST", "PUBLIC_KEY_RESPONSE", "PRIVATE_KEY_REQUEST",
+    "PRIVATE_KEY_RESPONSE", "GROUP_REQUEST", "SHUTDOWN_REQUEST",
+    "SHUTDOWN_RESPONSE", "START_FOLLOW_REQUEST", "FOLLOW_PROGRESS",
 ]
 
 
@@ -366,3 +371,48 @@ DKG_BUNDLE = {
 DKG_BUNDLE_ARMS = ("deal", "response", "justification")
 # protocol.proto DKGPacket { dkg.Packet dkg = 1; }
 DKG_PACKET = {1: ("dkg", ("msg", DKG_BUNDLE))}
+
+# --- control plane (control.proto:14-199) ----------------------------------
+
+SETUP_INFO_PACKET = {
+    1: ("leader", "bool"),
+    2: ("leader_address", "str"),
+    3: ("leader_tls", "bool"),
+    4: ("nodes", "u32"),
+    5: ("threshold", "u32"),
+    6: ("timeout", "u32"),          # seconds per DKG phase
+    7: ("beacon_offset", "u32"),
+    8: ("dkg_offset", "u32"),
+    9: ("secret", "bytes"),
+    10: ("force", "bool"),
+}
+ENTROPY_INFO = {1: ("script", "str"), 10: ("user_only", "bool")}
+INIT_DKG_PACKET = {
+    1: ("info", ("msg", SETUP_INFO_PACKET)),
+    2: ("entropy", ("msg", ENTROPY_INFO)),
+    3: ("beacon_period", "u32"),
+    4: ("catchup_period", "u32"),
+}
+GROUP_INFO = {1: ("path", "str"), 2: ("url", "str")}  # oneof location
+INIT_RESHARE_PACKET = {
+    1: ("old", ("msg", GROUP_INFO)),
+    2: ("info", ("msg", SETUP_INFO_PACKET)),
+    3: ("catchup_period_changed", "bool"),
+    4: ("catchup_period", "u32"),
+}
+SHARE_REQUEST: dict = {}
+SHARE_RESPONSE = {2: ("index", "u32"), 3: ("share", "bytes")}
+PUBLIC_KEY_REQUEST: dict = {}
+PUBLIC_KEY_RESPONSE = {2: ("pub_key", "bytes")}
+PRIVATE_KEY_REQUEST: dict = {}
+PRIVATE_KEY_RESPONSE = {2: ("pri_key", "bytes")}
+GROUP_REQUEST: dict = {}
+SHUTDOWN_REQUEST: dict = {}
+SHUTDOWN_RESPONSE: dict = {}
+START_FOLLOW_REQUEST = {
+    1: ("info_hash", "str"),        # hex
+    2: ("nodes", ("rep", "str")),
+    3: ("is_tls", "bool"),
+    4: ("up_to", "u64"),
+}
+FOLLOW_PROGRESS = {1: ("current", "u64"), 2: ("target", "u64")}

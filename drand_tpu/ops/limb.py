@@ -245,20 +245,40 @@ def _shift_stack(b: jnp.ndarray, out_len: int) -> jnp.ndarray:
     return b[..., idx] * mask
 
 
+def _conv_skew(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Anti-diagonal sums of the outer product via the skew-reshape
+    trick: (..., 32) x (..., 32) -> (..., 63) with EXACTLY the n*m = 1024
+    true limb products — the windowed gather form multiplied ~50% zeros.
+
+    outer[i, j] = a_i * b_j padded to row width 2n, flattened, then
+    re-viewed at row stride 2n-1: row i of the view is outer row i
+    shifted right by i (flat index i*(2n-1)+k = i*2n + (k-i)), so a
+    single sum over rows yields C[k] = sum_{i+j=k} a_i b_j. Values are
+    bit-identical to the gather form (same non-negative int32 products,
+    associative sum). ~5 HLOs — keeps the jit graph as small as the
+    gather it replaces.
+    NB: explicit multiply+sum, NOT einsum/dot — integer dots may be
+    lowered through inexact float accumulation paths on some backends."""
+    outer = a[..., :, None] * b[..., None, :]        # (..., 32, 32)
+    z = jnp.zeros(outer.shape[:-1] + (NLIMBS,), DTYPE)
+    x = jnp.concatenate([outer, z], axis=-1)         # (..., 32, 64)
+    flat = x.reshape(x.shape[:-2] + (2 * NLIMBS * NLIMBS,))
+    skew = flat[..., : NLIMBS * (2 * NLIMBS - 1)].reshape(
+        x.shape[:-2] + (NLIMBS, 2 * NLIMBS - 1))
+    return jnp.sum(skew, axis=-2, dtype=DTYPE)       # (..., 63)
+
+
 def _conv_full(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Product convolution: (..., 32) x (..., 32) -> (..., 64), limb values
-    <= 2^29. One reduction over the limb axis — no sequential chain.
-    NB: explicit multiply+sum, NOT einsum/dot — integer dots may be lowered
-    through inexact float accumulation paths on some backends."""
-    bs = _shift_stack(b, 2 * NLIMBS)
-    return jnp.sum(a[..., None] * bs, axis=-2, dtype=DTYPE)
+    <= 2^29."""
+    c = _conv_skew(a, b)
+    return jnp.pad(c, [(0, 0)] * (c.ndim - 1) + [(0, 1)])
 
 
 def _conv_lo(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Low half of the convolution: result limbs 0..31 only (values mod-2^384
     arithmetic — exactly what Montgomery's m needs)."""
-    bs = _shift_stack(b, 2 * NLIMBS)[..., :NLIMBS]
-    return jnp.sum(a[..., None] * bs, axis=-2, dtype=DTYPE)
+    return _conv_skew(a, b)[..., :NLIMBS]
 
 
 def _fold_drop(t: jnp.ndarray, rounds: int) -> jnp.ndarray:

@@ -1,0 +1,125 @@
+"""Control-plane protobuf interop: reference operator tooling semantics.
+
+Drives a REAL daemon pair through the control port using control.proto
+framing only (no JSON): PingPong, InitDKG (leader side), Share,
+PublicKey, GroupFile, ChainInfo and Shutdown — the packet shapes of
+protobuf/drand/control.proto:14-37, which is what `drand share/stop/
+show` send (net/control.go ControlClient). The follower runs the same
+DKG through the daemon API directly; the leader's group response coming
+back as a GroupPacket proves the codec end to end.
+"""
+
+import asyncio
+
+import grpc
+import grpc.aio
+import pytest
+
+from drand_tpu.core.config import Config
+from drand_tpu.core.daemon import Drand
+from drand_tpu.key.group import Group
+from drand_tpu.key.store import FileStore
+from drand_tpu.net import protowire as pw
+from drand_tpu.net.control import ControlServer
+from drand_tpu.net.transport import LocalNetwork
+from drand_tpu.utils.clock import FakeClock
+
+SECRET = b"setup-secret-0123456789abcdef"
+
+
+def make_daemon(i, net, clock, tmp_path):
+    addr = f"d{i}.test:71{i:02d}"
+    ks = FileStore(str(tmp_path / f"node{i}"))
+    conf = Config(clock=clock, dkg_timeout=10)
+    d = Drand.fresh(ks, conf, net.client_for(addr), addr)
+    net.register(addr, d)
+    return addr, d
+
+
+@pytest.mark.asyncio
+async def test_control_protobuf_full_cycle(tmp_path):
+    clock = FakeClock(1_700_000_000.0)
+    net = LocalNetwork()
+    addr0, d0 = make_daemon(0, net, clock, tmp_path)
+    addr1, d1 = make_daemon(1, net, clock, tmp_path)
+
+    ctl = ControlServer(d0, 0)
+    await ctl.start()
+    ch = grpc.aio.insecure_channel(f"127.0.0.1:{ctl.port}")
+
+    async def call(method, spec, payload, resp_spec, timeout=60.0):
+        fn = ch.unary_unary(f"/drand.Control/{method}")
+        raw = await fn(pw.encode(spec, payload), timeout=timeout)
+        return pw.decode(resp_spec, raw)
+
+    try:
+        # PingPong over the empty protobuf message
+        assert await call("PingPong", pw.EMPTY, {}, pw.EMPTY) == {}
+
+        # InitDKG via protobuf on the leader; follower joins natively
+        # (leader first: the follower's signal needs the setup manager)
+        leader = asyncio.ensure_future(call(
+            "InitDKG", pw.INIT_DKG_PACKET, {
+                "info": {"leader": True, "nodes": 2, "threshold": 2,
+                         "timeout": 20, "secret": SECRET},
+                "beacon_period": 5,
+            }, pw.GROUP_PACKET, timeout=120.0))
+        await asyncio.sleep(0.2)
+        await d1.init_dkg_follower(addr0, SECRET, timeout=20)
+        gp = await leader
+        group = Group.from_proto_dict(gp)
+        assert group.threshold == 2 and len(group.nodes) == 2
+        assert group.period == 5
+        assert group.hash() == d0.group.hash()
+        assert gp["dist_key"], "distributed key missing from GroupPacket"
+
+        # Share: index + 32-byte big-endian scalar (ShareResponse:2,3)
+        sh = await call("Share", pw.SHARE_REQUEST, {}, pw.SHARE_RESPONSE)
+        assert sh["index"] == d0.share.pri_share.index
+        assert len(sh["share"]) == 32
+        assert int.from_bytes(sh["share"], "big") > 0
+
+        # PublicKey: compressed G1 key (PublicKeyResponse:2)
+        pk = await call("PublicKey", pw.PUBLIC_KEY_REQUEST, {},
+                        pw.PUBLIC_KEY_RESPONSE)
+        assert pk["pub_key"] == d0.priv.public.key.to_bytes()
+
+        # GroupFile round-trips the same group
+        gf = await call("GroupFile", pw.GROUP_REQUEST, {}, pw.GROUP_PACKET)
+        assert Group.from_proto_dict(gf).hash() == d0.group.hash()
+
+        # ChainInfo carries the group public key
+        ci = await call("ChainInfo", pw.CHAIN_INFO_REQUEST, {},
+                        pw.CHAIN_INFO_PACKET)
+        assert ci["public_key"] == d0.group.public_key.key().to_bytes()
+        assert ci["period"] == 5
+
+        # Shutdown via protobuf framing stops the daemon
+        await call("Shutdown", pw.SHUTDOWN_REQUEST, {},
+                   pw.SHUTDOWN_RESPONSE)
+        assert d0.beacon is None or d0._stopped  # daemon stopped
+    finally:
+        await ch.close()
+        await ctl.stop()
+        d1.stop()
+
+
+@pytest.mark.asyncio
+async def test_control_json_still_native(tmp_path):
+    """The JSON codec keeps working on the shared method names."""
+    import json
+
+    clock = FakeClock(1_700_000_000.0)
+    net = LocalNetwork()
+    _, d0 = make_daemon(0, net, clock, tmp_path)
+    ctl = ControlServer(d0, 0)
+    await ctl.start()
+    ch = grpc.aio.insecure_channel(f"127.0.0.1:{ctl.port}")
+    try:
+        fn = ch.unary_unary("/drand.Control/PublicKey")
+        raw = await fn(json.dumps({}).encode(), timeout=10.0)
+        out = json.loads(raw)
+        assert out["public_key"] == d0.priv.public.key.to_bytes().hex()
+    finally:
+        await ch.close()
+        await ctl.stop()

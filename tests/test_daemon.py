@@ -172,3 +172,87 @@ async def test_daemon_reshare_grows_group(tmp_path):
         assert verify_beacon(pub, b)
     for d in daemons + [joiner]:
         d.stop()
+
+
+@pytest.mark.asyncio
+async def test_reshare_timeout_aborts_and_retry_succeeds(tmp_path):
+    """Adversarial reshare path (core/drand_test.go:261 timeout case): a
+    reshare whose participants never show up times out WITHOUT disturbing
+    the running chain, and a subsequent reshare attempt succeeds."""
+    clock = FakeClock()
+    net = LocalNetwork()
+    daemons, group = await form_network(2, 2, net, clock, tmp_path)
+    await clock.advance_to(group.genesis_time)
+    await clock.advance(PERIOD)
+    for d in daemons:
+        await wait_chain(d, 1)
+
+    reshare_secret = b"reshare-secret-aaaaaaaaaaaaaaaa"
+    # expected_n=3 but nobody else signals: leader setup must time out
+    with pytest.raises(TimeoutError, match="participants signalled"):
+        await daemons[0].init_reshare_leader(3, 2, reshare_secret,
+                                             timeout=0.5)
+    assert daemons[0]._setup_mgr is None, "failed setup not cleaned up"
+
+    # chain still alive on the OLD group
+    await clock.advance(PERIOD)
+    for d in daemons:
+        await wait_chain(d, 2)
+        assert verify_beacon(group.public_key.key(), d.beacon.chain.get(2))
+
+    # retry with the full membership: succeeds and transitions
+    tasks = [asyncio.ensure_future(
+        daemons[0].init_reshare_leader(2, 2, reshare_secret, timeout=20))]
+    tasks.append(asyncio.ensure_future(
+        daemons[1].init_reshare_follower(daemons[0].priv.public.addr,
+                                         reshare_secret, timeout=20)))
+    new_groups = await asyncio.gather(*tasks)
+    assert new_groups[0].hash() == new_groups[1].hash()
+    assert new_groups[0].public_key.key() == group.public_key.key()
+    for d in daemons:
+        d.stop()
+
+
+@pytest.mark.asyncio
+async def test_second_setup_rejected_unless_forced(tmp_path):
+    """Preemption guard (core/drand_test.go:182 preempt case +
+    drand_control.go force flag): a second concurrent setup errors
+    without force; with force it cancels the pending one."""
+    from drand_tpu.core.daemon import DrandError
+    from drand_tpu.core.setup import SetupPreempted
+
+    clock = FakeClock()
+    net = LocalNetwork()
+    daemons, group = await form_network(2, 2, net, clock, tmp_path)
+    await clock.advance_to(group.genesis_time)
+    await clock.advance(PERIOD)
+    for d in daemons:
+        await wait_chain(d, 1)
+
+    reshare_secret = b"reshare-secret-aaaaaaaaaaaaaaaa"
+    # first reshare waits for a third participant that never comes
+    first = asyncio.ensure_future(
+        daemons[0].init_reshare_leader(3, 2, reshare_secret, timeout=30))
+    await asyncio.sleep(0.05)
+    assert daemons[0]._setup_mgr is not None
+
+    # un-forced second setup is rejected while the first is pending
+    with pytest.raises(DrandError, match="already in progress"):
+        await daemons[0].init_reshare_leader(2, 2, reshare_secret,
+                                             timeout=5)
+    assert not first.done()
+
+    # forced second setup preempts the first and completes
+    second = asyncio.ensure_future(
+        daemons[0].init_reshare_leader(2, 2, reshare_secret, timeout=20,
+                                       force=True))
+    follower = asyncio.ensure_future(
+        daemons[1].init_reshare_follower(daemons[0].priv.public.addr,
+                                         reshare_secret, timeout=20))
+    with pytest.raises(SetupPreempted):
+        await first
+    new_groups = await asyncio.gather(second, follower)
+    assert new_groups[0].hash() == new_groups[1].hash()
+    assert new_groups[0].public_key.key() == group.public_key.key()
+    for d in daemons:
+        d.stop()
