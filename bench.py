@@ -30,6 +30,11 @@ Environment knobs:
     BENCH_CONFIGS      comma list to run: any of
                        e2e,catchup,recover,deal,replay,headline
                        (default: all)
+    DRAND_TPU_CONV     tree|kara|unroll — limb conv strategy (A/B)
+    DRAND_TPU_LAZY     1|0 — lazy Fp2/6/12 reduction (A/B)
+    DRAND_TPU_PAIRFOLD 1|0 — paired-line Miller fold (A/B)
+                       (knobs are recorded in the headline JSON;
+                       scripts/ab_bench.sh runs the matrix)
 
 Reference hot paths measured: chain/beacon/chain.go:136-141 (aggregator
 recover+verify), client/verify.go:146-163 (catchup), kyber vss deal
@@ -169,11 +174,15 @@ def bench_headline(trials, min_seconds):
         log("FATAL: no batch size produced correct results")
         raise SystemExit(1)
     rate, batch, per_call, per_call_med = best_rate
+    from drand_tpu.ops import bl as _bl
+
     return {"metric": "pairings_per_sec", "value": round(rate, 1),
             "unit": "pairings/s", "vs_baseline": round(rate / 200000.0, 4),
             "batch": batch, "ms_per_call": round(per_call * 1e3, 2),
             "median_rate": round(2 * batch / per_call_med, 1),
-            "median_ms_per_call": round(per_call_med * 1e3, 2)}
+            "median_ms_per_call": round(per_call_med * 1e3, 2),
+            # A/B knobs active for this record (all trace-time consts)
+            "conv": _bl.CONV_MODE, "lazy": _bl.LAZY, "pairfold": pp.PAIRFOLD}
 
 
 def bench_catchup(trials, n_rounds=10_000):

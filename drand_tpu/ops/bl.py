@@ -555,6 +555,10 @@ def _u_sub(x, y, site: str):
     if prof.ndim == 1:
         prof = prof[:, None]
     yw = y.shape[-2]
+    # y must span the site's full profile width: D cancels the WHOLE
+    # profile sum mod p, so a narrower y would leave the tail
+    # uncancelled (pad y with zero limbs at the call site)
+    assert yw >= len(_USUB_PROFILES[site]), (site, yw)
     comp = prof[:yw] - y
     d = _colrow(_csec(f"UD_{site}")[0])
     xw = x.shape[-2]
@@ -912,7 +916,67 @@ def f12_frobenius(a, power: int = 1):
 
 # -- cyclotomic squaring ----------------------------------------------------
 
+def _f12_cyclotomic_sqr_lazy(a):
+    """Granger–Scott cyclotomic square with the SQUARE combines in the
+    lazy domain: 18 product convolutions in ONE stacked call, 12 REDCs
+    (was 18). The 3t±2g finish stays in the reduced domain — g is
+    Montgomery-scale (gR) while lazy squares are product-scale (xyR^2),
+    and the two cannot be combined pre-REDC without an extra lifting
+    convolution that would cost the saving back. Bounds: lazy squares
+    <= 2^18.2/2^769.2 after fold; A/B <= 2^20.6 limbs / <= 2^773.3
+    value ("T" subs) — under redc's 2^30 / 2^778 ceilings."""
+    w = f12_to_w(a)
+    g = [w[..., i, :, :, :] for i in range(6)]
+    rows_a, rows_b = [], []
+    for x, y in ((g[0], g[3]), (g[1], g[4]), (g[2], g[5])):
+        s = f2_add(x, y)
+        for v in (x, y, s):
+            v0, v1 = v[..., 0, :, :], v[..., 1, :, :]
+            rows_a += [add(v0, v1), v0]
+            rows_b += [sub(v0, v1), v1]
+    pa = jnp.stack(rows_a, axis=-3)              # (..., 18, 32, B)
+    pb = jnp.stack(rows_b, axis=-3)
+    wv = _conv_tree(pa, pb, 2 * NLIMBS)          # (..., 18, 64, B)
+
+    def sq(j):
+        """Lazy f2 square j: ((a0+a1)(a0-a1), 2*a0a1), width _UW."""
+        s0 = _u_pad(_u_fold1(wv[..., 2 * j, :, :]), _UW)
+        d = wv[..., 2 * j + 1, :, :]
+        s1 = _u_pad(_u_fold1(d + d), _UW)
+        return s0, s1
+
+    AB = []
+    for pi in range(3):
+        t0, t1, t2 = sq(3 * pi), sq(3 * pi + 1), sq(3 * pi + 2)
+        # A = t0 + xi*t1 ; B = (x+y)^2 - t0 - t1
+        A = (t0[0] + _u_sub(t1[0], t1[1], "T"), t0[1] + t1[0] + t1[1])
+        B = (_u_sub(_u_sub(t2[0], t0[0], "T"), t1[0], "T"),
+             _u_sub(_u_sub(t2[1], t0[1], "T"), t1[1], "T"))
+        AB.append((A, B))
+
+    r = _redc_pairs([p for ab in AB for p in ab])  # (..., 6, 2, 32, B)
+    return _cyc_finish(g, r[..., 0, :, :, :], r[..., 1, :, :, :],
+                       r[..., 2, :, :, :], r[..., 3, :, :, :],
+                       r[..., 4, :, :, :], r[..., 5, :, :, :])
+
+
+def _cyc_finish(g, a0, a1, b0, b1, c0, c1):
+    """Granger–Scott 3t±2g finish, reduced domain (shared by the lazy
+    and eager square paths)."""
+    def fmi(goal, t):  # 3t - 2*goal
+        return f2_add(f2_mul_small(f2_sub(t, goal), 2), t)
+
+    def gpl(goal, t):  # 3t + 2*goal
+        return f2_add(f2_mul_small(f2_add(t, goal), 2), t)
+
+    h = [fmi(g[0], a0), gpl(g[1], f2_mul_by_xi(c1)), fmi(g[2], b0),
+         gpl(g[3], a1), fmi(g[4], c0), gpl(g[5], b1)]
+    return f12_from_w(jnp.stack(h, axis=-4))
+
+
 def f12_cyclotomic_sqr(a):
+    if LAZY:
+        return _f12_cyclotomic_sqr_lazy(a)
     w = f12_to_w(a)
     g = [w[..., i, :, :, :] for i in range(6)]
 
@@ -925,16 +989,7 @@ def f12_cyclotomic_sqr(a):
     a0, a1 = sq2(g[0], g[3])
     b0, b1 = sq2(g[1], g[4])
     c0, c1 = sq2(g[2], g[5])
-
-    def fmi(goal, t):  # 3t - 2*goal
-        return f2_add(f2_mul_small(f2_sub(t, goal), 2), t)
-
-    def gpl(goal, t):  # 3t + 2*goal
-        return f2_add(f2_mul_small(f2_add(t, goal), 2), t)
-
-    h = [fmi(g[0], a0), gpl(g[1], f2_mul_by_xi(c1)), fmi(g[2], b0),
-         gpl(g[3], a1), fmi(g[4], c0), gpl(g[5], b1)]
-    return f12_from_w(jnp.stack(h, axis=-4))
+    return _cyc_finish(g, a0, a1, b0, b1, c0, c1)
 
 
 # ---------------------------------------------------------------------------

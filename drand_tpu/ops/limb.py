@@ -113,29 +113,8 @@ _P_MULTIPLES = np.stack(
     [int_to_limbs(k * P, NLIMBS + 1) for k in range(R_MONT // P + 1)]
 )
 
-# Montgomery inner-step shift rows: row i holds P_LIMBS placed at offset i in
-# a 2*NLIMBS-wide vector (for the unrolled reduction's fused multiply-add).
-_P_SHIFT = np.zeros((NLIMBS, 2 * NLIMBS), dtype=np.int32)
-for _i in range(NLIMBS):
-    _P_SHIFT[_i, _i : _i + NLIMBS] = P_LIMBS
-_P_SHIFT.setflags(write=False)
 _WRAP_ROWS.setflags(write=False)
 _P_MULTIPLES.setflags(write=False)
-
-# Gather tables for the shifted-stack convolution: row i of the stack is b
-# shifted up by i limbs. _SHIFT_IDX[i, j] = j - i (clamped to range),
-# _SHIFT_MASK zeroes the out-of-range positions. One gather + one multiply
-# replaces 32 pad ops — keeps the jit graph small (compile-time critical).
-_SHIFT_IDX = np.zeros((NLIMBS, 2 * NLIMBS), dtype=np.int32)
-_SHIFT_MASK = np.zeros((NLIMBS, 2 * NLIMBS), dtype=np.int32)
-for _i in range(NLIMBS):
-    for _j in range(2 * NLIMBS):
-        _k = _j - _i
-        if 0 <= _k < NLIMBS:
-            _SHIFT_IDX[_i, _j] = _k
-            _SHIFT_MASK[_i, _j] = 1
-_SHIFT_IDX.setflags(write=False)
-_SHIFT_MASK.setflags(write=False)
 
 
 # ---------------------------------------------------------------------------
@@ -235,14 +214,6 @@ def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
 
 def double(a: jnp.ndarray) -> jnp.ndarray:
     return mul_small(a, 2)
-
-
-def _shift_stack(b: jnp.ndarray, out_len: int) -> jnp.ndarray:
-    """(..., 32) -> (..., 32, out_len): row i is b shifted up by i limbs.
-    One gather + mask — compile-cheap, fully parallel."""
-    idx = jnp.asarray(_SHIFT_IDX[:, :out_len])
-    mask = jnp.asarray(_SHIFT_MASK[:, :out_len])
-    return b[..., idx] * mask
 
 
 def _conv_skew(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:

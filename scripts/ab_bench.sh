@@ -1,0 +1,25 @@
+#!/bin/bash
+# A/B the round-5 perf features on the real chip (VERDICT r4 item 1).
+#
+# Runs the headline config under each knob combination, interleaved so
+# the tunnel's minute-scale load variance hits all variants alike, and
+# prints one JSON line per run (knobs are embedded in each record).
+# Usage:  scripts/ab_bench.sh [trials_per_variant]
+set -u
+cd "$(dirname "$0")/.."
+REPS=${1:-2}
+export BENCH_CONFIGS=headline BENCH_BATCH=${BENCH_BATCH:-128} BENCH_TRIALS=${BENCH_TRIALS:-2}
+VARIANTS=(
+  "DRAND_TPU_LAZY=1 DRAND_TPU_PAIRFOLD=1 DRAND_TPU_CONV=tree"   # full r5
+  "DRAND_TPU_LAZY=0 DRAND_TPU_PAIRFOLD=1 DRAND_TPU_CONV=tree"   # -lazy
+  "DRAND_TPU_LAZY=1 DRAND_TPU_PAIRFOLD=0 DRAND_TPU_CONV=tree"   # -pairfold
+  "DRAND_TPU_LAZY=0 DRAND_TPU_PAIRFOLD=0 DRAND_TPU_CONV=tree"   # r4 tree
+  "DRAND_TPU_LAZY=0 DRAND_TPU_PAIRFOLD=0 DRAND_TPU_CONV=unroll" # r3 base
+)
+for rep in $(seq 1 "$REPS"); do
+  for v in "${VARIANTS[@]}"; do
+    pkill -f "python bench.py" 2>/dev/null; sleep 1
+    echo "### rep $rep: $v" >&2
+    env $v python bench.py 2>>/tmp/ab_bench.err | tail -1
+  done
+done

@@ -139,3 +139,21 @@ def test_redc_magnitude_ceiling():
             val = _x.limbs_to_int(lim)
             got = bl.unpack_fp(np.asarray(bl.redc(t)))[0]
             assert got == val * RINV % P * RINV % P, vbits
+
+
+def test_cyclotomic_sqr_lazy_matches_host():
+    """Lazy Granger-Scott square on real cyclotomic-subgroup elements
+    (pairing outputs), chained to exercise non-canonical feedback."""
+    from drand_tpu.crypto.pairing import pairing as host_pairing
+    from drand_tpu.crypto.curves import PointG1, PointG2
+
+    elems = [host_pairing(PointG1.generator().mul(rng.randrange(1, 1 << 40)),
+                          PointG2.generator().mul(rng.randrange(1, 1 << 40)))
+             for _ in range(B)]
+    x_d = jnp.asarray(pack12(elems))
+    x_h = list(elems)
+    for step in range(4):
+        x_d = bl.f12_cyclotomic_sqr(x_d)
+        x_h = [v * v for v in x_h]
+        for i in range(B):
+            assert unpack12(x_d, i) == flat12(x_h[i]), (step, i)
