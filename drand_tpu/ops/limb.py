@@ -216,18 +216,51 @@ def double(a: jnp.ndarray) -> jnp.ndarray:
     return mul_small(a, 2)
 
 
+# Gather tables for the shifted-stack convolution: row i of the stack is b
+# shifted up by i limbs. _SHIFT_IDX[i, j] = j - i (clamped to range),
+# _SHIFT_MASK zeroes the out-of-range positions.
+_SHIFT_IDX = np.zeros((NLIMBS, 2 * NLIMBS), dtype=np.int32)
+_SHIFT_MASK = np.zeros((NLIMBS, 2 * NLIMBS), dtype=np.int32)
+for _i in range(NLIMBS):
+    for _j in range(2 * NLIMBS):
+        _k = _j - _i
+        if 0 <= _k < NLIMBS:
+            _SHIFT_IDX[_i, _j] = _k
+            _SHIFT_MASK[_i, _j] = 1
+_SHIFT_IDX.setflags(write=False)
+_SHIFT_MASK.setflags(write=False)
+
+# XLA-path conv strategy (trace-time constant, like bl.CONV_MODE):
+#   "gather" (default): one gather + mask + multiply-sum — 2048 lane
+#       multiplies of which half are masked zeros, but measured 7x
+#       FASTER at execution on XLA:CPU than the skew form (9.6 -> 1.4 ms
+#       for a 255-step scan at B=64; XLA:CPU fuses the gather+reduce,
+#       while skew's pad/flatten/reshape materializes copies per step).
+#       This is also the form behind every r3/r4 TPU measurement.
+#   "skew": outer product + stride-trick reshape — exactly the 1024
+#       true products; candidate for the TPU fused-aggregator path
+#       (ROOFLINE r5), to be A/B'd on hardware before becoming default.
+XCONV_MODE = __import__("os").environ.get("DRAND_TPU_XCONV", "gather")
+
+
+def _shift_stack(b: jnp.ndarray, out_len: int) -> jnp.ndarray:
+    """(..., 32) -> (..., 32, out_len): row i is b shifted up by i limbs."""
+    idx = jnp.asarray(_SHIFT_IDX[:, :out_len])
+    mask = jnp.asarray(_SHIFT_MASK[:, :out_len])
+    return b[..., idx] * mask
+
+
 def _conv_skew(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Anti-diagonal sums of the outer product via the skew-reshape
     trick: (..., 32) x (..., 32) -> (..., 63) with EXACTLY the n*m = 1024
-    true limb products — the windowed gather form multiplied ~50% zeros.
+    true limb products — the windowed gather form multiplies ~50% zeros.
 
     outer[i, j] = a_i * b_j padded to row width 2n, flattened, then
     re-viewed at row stride 2n-1: row i of the view is outer row i
     shifted right by i (flat index i*(2n-1)+k = i*2n + (k-i)), so a
     single sum over rows yields C[k] = sum_{i+j=k} a_i b_j. Values are
     bit-identical to the gather form (same non-negative int32 products,
-    associative sum). ~5 HLOs — keeps the jit graph as small as the
-    gather it replaces.
+    associative sum).
     NB: explicit multiply+sum, NOT einsum/dot — integer dots may be
     lowered through inexact float accumulation paths on some backends."""
     outer = a[..., :, None] * b[..., None, :]        # (..., 32, 32)
@@ -242,14 +275,20 @@ def _conv_skew(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 def _conv_full(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Product convolution: (..., 32) x (..., 32) -> (..., 64), limb values
     <= 2^29."""
-    c = _conv_skew(a, b)
-    return jnp.pad(c, [(0, 0)] * (c.ndim - 1) + [(0, 1)])
+    if XCONV_MODE == "skew":
+        c = _conv_skew(a, b)
+        return jnp.pad(c, [(0, 0)] * (c.ndim - 1) + [(0, 1)])
+    bs = _shift_stack(b, 2 * NLIMBS)
+    return jnp.sum(a[..., None] * bs, axis=-2, dtype=DTYPE)
 
 
 def _conv_lo(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Low half of the convolution: result limbs 0..31 only (values mod-2^384
     arithmetic — exactly what Montgomery's m needs)."""
-    return _conv_skew(a, b)[..., :NLIMBS]
+    if XCONV_MODE == "skew":
+        return _conv_skew(a, b)[..., :NLIMBS]
+    bs = _shift_stack(b, 2 * NLIMBS)[..., :NLIMBS]
+    return jnp.sum(a[..., None] * bs, axis=-2, dtype=DTYPE)
 
 
 def _fold_drop(t: jnp.ndarray, rounds: int) -> jnp.ndarray:

@@ -14,10 +14,18 @@ Delta vs the reference's libp2p gossipsub, for operators:
   (/drand/pubsub/v0.0.0/<chainHash>) therefore cannot be joined — use
   the drand.Public protobuf service (net/protowire.py) for ecosystem
   interop instead.
-- NO peer scoring/pruning (gossipsub v1.1): a misbehaving peer is
-  bounded by validation (invalid beacons never forward; per-message
-  hash dedup caps amplification at one delivery per peer per message)
-  but stays in the mesh; drop it from --peers to evict.
+- Peer scoring/pruning is a BOUNDED analogue of gossipsub v1.1's, not
+  the full behavioural score: an ingress SOURCE IP is banned for a
+  cooloff window after SCORE_INVALID_LIMIT validation-rejected
+  deliveries (attribution by connection source address — gossipsub's
+  IP-colocation factor; there is no libp2p peer identity on this
+  plane, and a sender-claimed header would let anyone frame a victim),
+  and a mesh peer is evicted after SCORE_FAIL_LIMIT consecutive
+  CONNECTIVITY failures (application rejections like a remote's own
+  cooloff do NOT count), redialed after EVICT_COOLOFF. Co-located
+  peers share ban fate (the IP-colocation tradeoff); validation still
+  bounds the damage regardless (invalid beacons never forward; hash
+  dedup caps amplification at one delivery per peer per message).
 - Flood (every message to every peer) instead of mesh-degree-bounded
   gossip: per-message cost is O(peers), the right trade at the handful-
   of-relays scale this deployment targets.
@@ -42,6 +50,39 @@ from ..utils.logging import KVLogger, default_logger
 
 SERVICE = "drand.Gossip"
 
+# scoring bounds (gossipsub v1.1 pruning analogue)
+SCORE_INVALID_LIMIT = 20   # validation-rejected deliveries before ban
+SCORE_FAIL_LIMIT = 10      # consecutive forward failures before ban
+EVICT_COOLOFF = 300.0      # seconds before a banned peer is redialed
+
+
+class _PeerState:
+    __slots__ = ("channel", "fails", "banned_until")
+
+    def __init__(self, channel):
+        self.channel = channel
+        self.fails = 0
+        self.banned_until = 0.0
+
+
+class _IpScore:
+    __slots__ = ("invalid", "banned_until")
+
+    def __init__(self):
+        self.invalid = 0
+        self.banned_until = 0.0
+
+
+def _peer_ip(grpc_peer: str) -> str:
+    """'ipv4:1.2.3.4:567' / 'ipv6:[::1]:8' -> address without the port."""
+    if grpc_peer.startswith("ipv6:"):
+        body = grpc_peer[5:]
+        return body[1:body.rfind("]")] if "[" in body else body
+    if ":" in grpc_peer:
+        kind, _, rest = grpc_peer.partition(":")
+        return rest.rsplit(":", 1)[0] if kind == "ipv4" else grpc_peer
+    return grpc_peer
+
 
 class GossipNode(Client):
     """One pubsub participant: subscribe/publish beacons for one chain.
@@ -59,7 +100,8 @@ class GossipNode(Client):
         self.chain_info = info
         self._clock = clock or SystemClock()
         self._l = logger or default_logger("gossip")
-        self._peers: dict[str, grpc.aio.Channel] = {}
+        self._peers: dict[str, _PeerState] = {}
+        self._ip_scores: dict[str, _IpScore] = {}
         self._seen: dict[bytes, None] = {}  # insertion-ordered for FIFO evict
         self._cache: dict[int, Beacon] = {}
         self._cache_rounds = cache_rounds
@@ -82,12 +124,58 @@ class GossipNode(Client):
     async def stop(self) -> None:
         if self._server is not None:
             await self._server.stop(0.2)
-        for ch in self._peers.values():
-            await ch.close()
+        for st in self._peers.values():
+            if st.channel is not None:
+                await st.channel.close()
 
     def add_peer(self, addr: str) -> None:
         if addr not in self._peers:
-            self._peers[addr] = grpc.aio.insecure_channel(addr)
+            self._peers[addr] = _PeerState(grpc.aio.insecure_channel(addr))
+
+    # ---------------------------------------------------------- scoring
+    def _ban_peer(self, addr: str, st: _PeerState, why: str) -> None:
+        st.banned_until = self._clock.now() + EVICT_COOLOFF
+        st.fails = 0
+        if st.channel is not None:
+            asyncio.ensure_future(st.channel.close())
+            st.channel = None
+        self._l.warn("gossip", "peer_evicted", peer=addr, why=why,
+                     cooloff_s=EVICT_COOLOFF)
+
+    def _live_channel(self, addr: str, st: _PeerState):
+        """Peer's channel if not banned; redials after the cooloff. A
+        peer whose host is an ingress-banned IP is also skipped (no
+        point feeding a co-located flooder)."""
+        now = self._clock.now()
+        if st.banned_until:
+            if now < st.banned_until:
+                return None
+            st.banned_until = 0.0
+            self._l.info("gossip", "peer_redialed", peer=addr)
+        ip = addr.rsplit(":", 1)[0]
+        sc = self._ip_scores.get(ip)
+        if sc is not None and now < sc.banned_until:
+            return None
+        if st.channel is None:
+            st.channel = grpc.aio.insecure_channel(addr)
+        return st.channel
+
+    def _ip_banned(self, ip: str) -> bool:
+        sc = self._ip_scores.get(ip)
+        return sc is not None and self._clock.now() < sc.banned_until
+
+    def _note_invalid(self, ip: str) -> None:
+        if not ip:
+            return
+        sc = self._ip_scores.setdefault(ip, _IpScore())
+        if self._clock.now() < sc.banned_until:
+            return
+        sc.invalid += 1
+        if sc.invalid >= SCORE_INVALID_LIMIT:
+            sc.invalid = 0
+            sc.banned_until = self._clock.now() + EVICT_COOLOFF
+            self._l.warn("gossip", "source_ip_banned", ip=ip,
+                         cooloff_s=EVICT_COOLOFF)
 
     # ---------------------------------------------------------- validation
     def _validate(self, b: Beacon) -> bool:
@@ -108,13 +196,18 @@ class GossipNode(Client):
         await self._accept(wire.encode(b), validate=True)
 
     async def _handle_publish(self, request: bytes, context) -> bytes:
+        ip = _peer_ip(context.peer() or "")
+        if self._ip_banned(ip):
+            await context.abort(grpc.StatusCode.PERMISSION_DENIED,
+                                "gossip: source is in eviction cooloff")
         try:
-            await self._accept(request, validate=True)
+            await self._accept(request, validate=True, sender=ip)
         except wire.WireError as e:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         return b"{}"
 
-    async def _accept(self, raw: bytes, validate: bool) -> None:
+    async def _accept(self, raw: bytes, validate: bool,
+                      sender: str = "") -> None:
         msg_id = hashlib.blake2b(raw, digest_size=16).digest()
         if msg_id in self._seen:
             return
@@ -125,6 +218,8 @@ class GossipNode(Client):
             # do NOT record rejected messages as seen: a beacon dropped for
             # clock skew must be acceptable when it arrives again later
             self._l.warn("gossip", "invalid_beacon_dropped", round=msg.round)
+            if sender:
+                self._note_invalid(sender)
             return
         self._seen[msg_id] = None
         while len(self._seen) > 4096:  # FIFO eviction (oldest first)
@@ -139,16 +234,29 @@ class GossipNode(Client):
                 q.put_nowait(msg)
             except asyncio.QueueFull:
                 pass
-        for addr, ch in self._peers.items():
-            asyncio.ensure_future(self._forward(addr, ch, raw))
+        for addr, st in self._peers.items():
+            if self._live_channel(addr, st) is not None:
+                asyncio.ensure_future(self._forward(addr, st, raw))
 
-    async def _forward(self, addr: str, ch: grpc.aio.Channel,
-                       raw: bytes) -> None:
+    async def _forward(self, addr: str, st: _PeerState, raw: bytes) -> None:
+        ch = st.channel
+        if ch is None:
+            return
         try:
             await ch.unary_unary(f"/{SERVICE}/Publish")(raw, timeout=5.0)
+            st.fails = 0
         except grpc.aio.AioRpcError as e:
             self._l.debug("gossip", "forward_failed", to=addr,
                           code=e.code().name)
+            # application-level rejections (e.g. the remote's own
+            # cooloff) are NOT connectivity failures — counting them
+            # would turn one ban into a mutual-ban cascade
+            if e.code() in (grpc.StatusCode.PERMISSION_DENIED,
+                            grpc.StatusCode.INVALID_ARGUMENT):
+                return
+            st.fails += 1
+            if st.fails >= SCORE_FAIL_LIMIT and not st.banned_until:
+                self._ban_peer(addr, st, "unreachable")
 
     # ------------------------------------------------------------- Client
     async def get(self, round_no: int = 0):

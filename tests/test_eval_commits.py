@@ -292,5 +292,5 @@ def test_msm_fold_bl_matches_host():
         Fp2.one())
     exp = PointG2.infinity()
     for p, s in zip(pts, scalars):
-        exp = exp.add(p.mul(s))
+        exp = exp + p.mul(s)
     assert got == exp

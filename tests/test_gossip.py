@@ -88,3 +88,81 @@ async def test_relay_feeds_mesh_from_live_network():
         await relay_node.stop()
         await sub_node.stop()
         net.stop_all()
+
+
+@pytest.mark.asyncio
+async def test_source_ip_scoring_eviction_and_recovery():
+    """Gossipsub-v1.1-analogue pruning: a source IP delivering
+    SCORE_INVALID_LIMIT invalid beacons is banned for EVICT_COOLOFF
+    (its deliveries refused, forwards to co-located peers skipped),
+    then traffic resumes after the cooloff."""
+    from drand_tpu.relay import gossip as g
+
+    mock = MockBeaconServer(nrounds=5)
+    clock = FakeClock(start=mock.chain_info.genesis_time + 1000)
+    a = GossipNode(mock.chain_info, clock=clock)
+    b = GossipNode(mock.chain_info, clock=clock)
+    await a.serve("127.0.0.1:0")
+    await b.serve("127.0.0.1:0")
+    addr_a, addr_b = (f"127.0.0.1:{a.port}", f"127.0.0.1:{b.port}")
+    a.add_peer(addr_b)
+    b.add_peer(addr_a)
+    try:
+        # a flood of invalid beacons from one source IP bans the IP at B
+        for i in range(g.SCORE_INVALID_LIMIT):
+            bad = Beacon(round=1, previous_sig=b"\x01" * 96 + bytes([i]),
+                         signature=b"\x99" * 96)
+            await b._accept(
+                __import__("drand_tpu.net.wire", fromlist=["wire"]).encode(
+                    bad), validate=True, sender="127.0.0.1")
+        sc = b._ip_scores["127.0.0.1"]
+        assert sc.banned_until > clock.now(), "source ip not banned"
+
+        # while banned, B refuses deliveries from that source
+        await a.publish(mock.beacons[1])
+        await asyncio.sleep(0.3)
+        assert b._tip == 0
+
+        # after the cooloff the flow resumes
+        await clock.advance(g.EVICT_COOLOFF + 1)
+        await a.publish(mock.beacons[2])
+        for _ in range(50):
+            if b._tip >= 2:
+                break
+            await asyncio.sleep(0.05)
+        assert b._tip == 2
+    finally:
+        await a.stop()
+        await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_dead_peer_evicted_after_forward_failures():
+    """A consistently unreachable peer is pruned after SCORE_FAIL_LIMIT
+    consecutive forward failures instead of being retried forever."""
+    from drand_tpu.relay import gossip as g
+
+    mock = MockBeaconServer(nrounds=g.SCORE_FAIL_LIMIT + 2)
+    clock = FakeClock(start=mock.chain_info.genesis_time + 1000)
+    a = GossipNode(mock.chain_info, clock=clock)
+    await a.serve("127.0.0.1:0")
+    a.add_peer("127.0.0.1:1")  # nothing listens there
+    try:
+        # each DISTINCT beacon triggers one forward attempt (dedup blocks
+        # repeats), so SCORE_FAIL_LIMIT publishes accumulate the failures
+        for r in range(1, g.SCORE_FAIL_LIMIT + 1):
+            await a.publish(mock.beacons[r])
+            st = a._peers["127.0.0.1:1"]
+            for _ in range(100):
+                if st.fails >= r or st.banned_until:
+                    break
+                await asyncio.sleep(0.02)
+        st = a._peers["127.0.0.1:1"]
+        for _ in range(100):
+            if st.banned_until:
+                break
+            await asyncio.sleep(0.05)
+        assert st.banned_until > clock.now()
+        assert st.channel is None
+    finally:
+        await a.stop()
