@@ -304,14 +304,34 @@ class Drand(ProtocolService):
         for n in extra or []:
             targets.setdefault(n.address(), n)
         targets.pop(self.priv.public.addr, None)
-        oks = 0
-        for node in targets.values():
+
+        async def push_one(node: Node):
             try:
                 await self.client.push_dkg_info(node.identity, packet)
-                oks += 1
+                return None
             except TransportError as e:
-                self._l.warn("push_group", "failed", to=node.address(),
-                             err=str(e))
+                return node, e
+
+        # all pushes CONCURRENT (reference sendout's per-peer goroutines,
+        # broadcast.go:143): a sequential pass would stall the leader's
+        # DKG start by up to client-timeout x n while followers that got
+        # the packet burn their phase clocks. One concurrent retry round
+        # for the misses; a lost push costs a whole DKG epoch.
+        pending: list[Node] = list(targets.values())
+        oks = 0
+        for attempt in ("failed", "retry_failed"):
+            results = await asyncio.gather(*(push_one(n) for n in pending))
+            pending = []
+            for r in results:
+                if r is None:
+                    oks += 1
+                else:
+                    node, err = r
+                    self._l.warn("push_group", attempt,
+                                 to=node.address(), err=str(err))
+                    pending.append(node)
+            if not pending:
+                break
         if oks + 1 < group.threshold:
             raise DrandError(
                 f"group push reached only {oks + 1} < threshold "

@@ -112,6 +112,14 @@ def verify_round(pub_hex: str, beacon: dict) -> bool:
     return ok
 
 
+def share_budget(args) -> tuple[str, int]:
+    """(CLI --timeout for `share`, orchestrator communicate() timeout):
+    the control call must outlive all three DKG phases plus slack, and
+    the outer wait must outlive the control call."""
+    cli = int(max(45, args.dkg_timeout * 3 + 30))
+    return str(cli), max(300, cli + 60)
+
+
 def run_reshare(args, nodes, workdir, secret_file, pub_hex, group) -> None:
     """Reshare plan (orchestrator.go:398 RunResharing): add K fresh nodes,
     run the resharing through the control plane, cross the transition, and
@@ -121,6 +129,7 @@ def run_reshare(args, nodes, workdir, secret_file, pub_hex, group) -> None:
     k = args.reshare_add
     new_n = len(nodes) + k
     new_thr = max(args.threshold + k // 2, new_n // 2 + 1)
+    share_timeout, outer_timeout = share_budget(args)
     log(f"resharing to {new_n} nodes (threshold {new_thr})...")
     joiners = [DemoNode(len(nodes) + j, workdir) for j in range(k)]
     for j in joiners:
@@ -133,14 +142,14 @@ def run_reshare(args, nodes, workdir, secret_file, pub_hex, group) -> None:
         [sys.executable, "-m", "drand_tpu.cli", "share",
          "--control", str(nodes[0].ctl), "--leader", "--reshare",
          "--nodes", str(new_n), "--threshold", str(new_thr),
-         "--secret-file", secret_file, "--timeout", "45"],
+         "--secret-file", secret_file, "--timeout", share_timeout],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         env=cli_env())]
     for n in nodes[1:]:
         procs.append(subprocess.Popen(
             [sys.executable, "-m", "drand_tpu.cli", "share",
              "--control", str(n.ctl), "--connect", nodes[0].addr,
-             "--reshare", "--secret-file", secret_file, "--timeout", "45"],
+             "--reshare", "--secret-file", secret_file, "--timeout", share_timeout],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=cli_env()))
     for j in joiners:
@@ -148,10 +157,10 @@ def run_reshare(args, nodes, workdir, secret_file, pub_hex, group) -> None:
             [sys.executable, "-m", "drand_tpu.cli", "share",
              "--control", str(j.ctl), "--connect", nodes[0].addr,
              "--reshare", "--from-group", group_file,
-             "--secret-file", secret_file, "--timeout", "45"],
+             "--secret-file", secret_file, "--timeout", share_timeout],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=cli_env()))
-    outs = [sp.communicate(timeout=300) for sp in procs]
+    outs = [sp.communicate(timeout=outer_timeout) for sp in procs]
     for sp, (so, se) in zip(procs, outs):
         if sp.returncode != 0:
             raise RuntimeError(f"reshare share failed:\n{so}\n{se}")
@@ -209,12 +218,13 @@ def main(argv=None) -> int:
             f.write("demo-secret-0123456789abcdef0000")
 
         log("running DKG...")
+        share_timeout, outer_timeout = share_budget(args)
         share_procs = []
         leader_args = ["share", "--control", str(nodes[0].ctl), "--leader",
                        "--nodes", str(args.nodes),
                        "--threshold", str(args.threshold),
                        "--period", str(args.period),
-                       "--secret-file", secret_file, "--timeout", "45"]
+                       "--secret-file", secret_file, "--timeout", share_timeout]
         share_procs.append(subprocess.Popen(
             [sys.executable, "-m", "drand_tpu.cli", *leader_args],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
@@ -223,10 +233,10 @@ def main(argv=None) -> int:
             share_procs.append(subprocess.Popen(
                 [sys.executable, "-m", "drand_tpu.cli", "share",
                  "--control", str(n.ctl), "--connect", nodes[0].addr,
-                 "--secret-file", secret_file, "--timeout", "45"],
+                 "--secret-file", secret_file, "--timeout", share_timeout],
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
                 env=cli_env()))
-        outs = [sp.communicate(timeout=300) for sp in share_procs]
+        outs = [sp.communicate(timeout=outer_timeout) for sp in share_procs]
         for sp, (so, se) in zip(share_procs, outs):
             if sp.returncode != 0:
                 raise RuntimeError(f"share failed:\n{so}\n{se}")

@@ -24,11 +24,14 @@ PLANS = [
                       "--rounds", "4", "--kill-one"]),
     ("reshare", ["--nodes", "3", "--threshold", "2", "--period", "3",
                  "--rounds", "2", "--reshare-add", "1"]),
-    # reference regression scale: n=5, t=4 (demo/regression/main.go:79-81)
-    ("startup-5", ["--nodes", "5", "--threshold", "4", "--period", "3",
-                   "--rounds", "3"]),
-    ("reshare-5", ["--nodes", "5", "--threshold", "4", "--period", "3",
-                   "--rounds", "2", "--reshare-add", "1"]),
+    # reference regression scale: n=5, t=4, period 10
+    # (demo/regression/main.go:79-81; the period also keeps 6 host-crypto
+    # daemons under one core's pairing budget during the reshare)
+    ("startup-5", ["--nodes", "5", "--threshold", "4", "--period", "10",
+                   "--rounds", "2"]),
+    ("reshare-5", ["--nodes", "5", "--threshold", "4", "--period", "10",
+                   "--rounds", "2", "--reshare-add", "1",
+                   "--dkg-timeout", "12"]),
 ]
 
 
