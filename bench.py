@@ -610,6 +610,7 @@ def main() -> None:
             log(f"headline FAILED ({final_state['error']}); aux configs "
                 f"will still run; final line will carry the error")
 
+
     def aux(name, fn):
         """Aux configs are best-effort: one failing must not kill the
         run or corrupt the final (headline) line."""
@@ -651,6 +652,42 @@ def main() -> None:
     if "e2e" in which and have_time("e2e"):
         log("== e2e 3-of-5 x 100 rounds ==")
         aux("e2e", bench_e2e)
+    # The round-5 perf knobs (lazy reduction, pair fold) ship CPU-golden
+    # when the tunnel is down at build time — the driver's bench may be
+    # their FIRST real Mosaic compile. If the headline failed while a
+    # knob is active (== "1", matching the consumers' gates), run ONE
+    # headline-only child with the r4-proven conservative knobs, after
+    # the parent's aux configs (so they are never lost), bounded by its
+    # own subprocess timeout (so an external driver deadline cannot be
+    # doubled). The child's record self-documents its knobs.
+    if ("headline" in which and headline is None
+            and not os.environ.get("BENCH_NO_FALLBACK")
+            and (os.environ.get("DRAND_TPU_LAZY", "1") == "1"
+                 or os.environ.get("DRAND_TPU_PAIRFOLD", "1") == "1")):
+        log("headline failed with the r5 knobs active — one headline-only "
+            "retry with DRAND_TPU_LAZY=0 DRAND_TPU_PAIRFOLD=0")
+        import subprocess
+
+        env = dict(os.environ, BENCH_NO_FALLBACK="1",
+                   BENCH_CONFIGS="headline",
+                   DRAND_TPU_LAZY="0", DRAND_TPU_PAIRFOLD="0")
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                capture_output=True, text=True, timeout=budget + 300)
+            sys.stderr.write(proc.stderr)
+            child_out = proc.stdout.strip()
+            if proc.returncode == 0 and child_out:
+                # the child's final line becomes OUR final line
+                print(child_out, flush=True)
+                final_state["emitted"] = True
+                done_event.set()
+                return
+            log(f"fallback bench rc={proc.returncode} — keeping the "
+                f"parent's record")
+        except subprocess.TimeoutExpired:
+            log("fallback bench timed out — keeping the parent's record")
+
     # LAST line is the headline (the driver parses the final JSON line),
     # or a structured error record if the headline was requested but
     # never materialized. When BENCH_CONFIGS excludes the headline, the
