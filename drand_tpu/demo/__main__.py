@@ -256,6 +256,7 @@ def main(argv=None) -> int:
             time.sleep(1)
 
         killed = None
+        recovering: set = set()
         for target in range(1, args.rounds + 1):
             deadline = time.time() + 60
             while time.time() < deadline:
@@ -269,7 +270,24 @@ def main(argv=None) -> int:
             for n in nodes:
                 if n.proc is None:
                     continue
-                b = n.get(f"/public/{target}")
+                # ONLY a freshly-restarted node gets a catch-up window;
+                # everyone else must serve the round on the first try
+                # (a healthy-looking node that cannot is the bug this
+                # check exists to catch). A dead process fails fast.
+                fetch_deadline = time.time() + (45 if n in recovering else 0)
+                while True:
+                    try:
+                        b = n.get(f"/public/{target}")
+                        recovering.discard(n)
+                        break
+                    except Exception:
+                        if n.proc is not None and n.proc.poll() is not None:
+                            raise RuntimeError(
+                                f"daemon {n.addr} exited rc="
+                                f"{n.proc.returncode} mid-run")
+                        if time.time() > fetch_deadline:
+                            raise
+                        time.sleep(1)
                 checks.append((n.addr, b["randomness"],
                                verify_round(pub_hex, b)))
             vals = {c[1] for c in checks}
@@ -285,6 +303,7 @@ def main(argv=None) -> int:
             if args.kill_one and target == args.rounds - 1 and killed is not None:
                 log(f"restarting {killed.addr}")
                 killed.start(args.dkg_timeout)
+                recovering.add(killed)
                 killed = None
 
         if args.reshare_add:
