@@ -526,21 +526,32 @@ def main() -> None:
     #    on a dying tunnel blocks in C and is unkillable from Python's
     #    main thread), emit the best headline measured so far — or the
     #    structured error — and force-exit 0.
+    # Every outage-path event is ALSO recorded as a structured
+    # `diagnostics` entry in the final JSON record, so BENCH_*.json
+    # distinguishes "tunnel down" from "regression" without stderr
+    # archaeology.
     final_state = {"emitted": False, "headline": None}
+    diagnostics = []
+
+    def diag(event, **kw):
+        diagnostics.append(dict({"event": event}, **kw))
+        log(f"DIAG: {event} {kw}")
 
     def emit_final(reason=None):
         if final_state["emitted"]:
             return
         final_state["emitted"] = True
         if final_state["headline"] is not None:
+            record = dict(final_state["headline"])
             if reason:
-                final_state["headline"] = dict(final_state["headline"],
-                                               note=reason)
-            emit(final_state["headline"])
+                record["note"] = reason
         else:
-            emit({"metric": "pairings_per_sec", "value": None,
-                  "unit": "pairings/s", "vs_baseline": None,
-                  "error": reason or "unknown failure before headline"})
+            record = {"metric": "pairings_per_sec", "value": None,
+                      "unit": "pairings/s", "vs_baseline": None,
+                      "error": reason or "unknown failure before headline"}
+        if diagnostics:
+            record["diagnostics"] = diagnostics
+        emit(record)
 
     hard_deadline = float(os.environ.get("BENCH_HARD_DEADLINE_SECONDS",
                                          str(budget + 900)))
@@ -553,6 +564,8 @@ def main() -> None:
             return
         log(f"WATCHDOG: bench exceeded hard deadline {hard_deadline:.0f}s "
             f"(tunnel hang mid-run?); emitting best-so-far and exiting")
+        diag("watchdog_fired", deadline_s=hard_deadline,
+             elapsed_s=round(time.perf_counter() - t_start, 1))
         emit_final(f"hard deadline {hard_deadline:.0f}s exceeded mid-run")
         os._exit(0)
 
@@ -561,10 +574,14 @@ def main() -> None:
 
     from drand_tpu.utils.backend import BackendUnavailable, init_backend
 
+    def _backend_failed(reason):
+        diag("backend_unavailable", reason=reason)
+        emit_final(reason)
+
     try:
         platform, devs = init_backend(
             deadline=float(os.environ.get("BENCH_BACKEND_DEADLINE", "180")),
-            on_fail=lambda reason: emit_final(reason), exit_code=0, log=log)
+            on_fail=_backend_failed, exit_code=0, log=log)
     except BackendUnavailable as e:
         # emit_final already ran via on_fail; exit 0 — an environmental
         # outage is a diagnosable record, not a bench bug
@@ -607,6 +624,7 @@ def main() -> None:
                 emit_final("interrupted during headline")
                 raise
             final_state["error"] = f"{type(e).__name__}: {e}"
+            diag("headline_failed", error=final_state["error"])
             log(f"headline FAILED ({final_state['error']}); aux configs "
                 f"will still run; final line will carry the error")
 
@@ -622,6 +640,8 @@ def main() -> None:
             import traceback
 
             log(traceback.format_exc())
+            diag("aux_config_failed", config=name,
+                 error=f"{type(e).__name__}: {e}")
             log(f"{name} FAILED ({type(e).__name__}: {e}) — continuing")
 
     # aux configs in decreasing information order; e2e (protocol
@@ -638,6 +658,7 @@ def main() -> None:
                 return bench_replay_measured(left, results.get("catchup"))
             except Exception as e:  # noqa: BLE001 — formula fallback keeps
                 # the config present in outage/degraded windows
+                diag("replay_measured_fallback", error=repr(e))
                 log(f"measured replay failed ({e!r}); formula fallback")
                 if results.get("catchup") or headline:
                     return bench_replay_1m(results.get("catchup"), headline)
@@ -666,6 +687,7 @@ def main() -> None:
                  or os.environ.get("DRAND_TPU_PAIRFOLD", "1") == "1")):
         log("headline failed with the r5 knobs active — one headline-only "
             "retry with DRAND_TPU_LAZY=0 DRAND_TPU_PAIRFOLD=0")
+        diag("headline_knob_retry", lazy=0, pairfold=0)
         import subprocess
 
         env = dict(os.environ, BENCH_NO_FALLBACK="1",
@@ -678,14 +700,27 @@ def main() -> None:
             sys.stderr.write(proc.stderr)
             child_out = proc.stdout.strip()
             if proc.returncode == 0 and child_out:
-                # the child's final line becomes OUR final line
-                print(child_out, flush=True)
+                # the child's final line becomes OUR final line — with
+                # the parent's diagnostics merged in, so the record
+                # still says WHY the retry happened (the r5-knob
+                # headline failure must not read as a clean run)
+                lines = child_out.splitlines()
+                try:
+                    record = json.loads(lines[-1])
+                    record["diagnostics"] = (diagnostics
+                                             + record.get("diagnostics", []))
+                    lines[-1] = json.dumps(record)
+                except ValueError:
+                    pass  # unparseable child line: print verbatim
+                print("\n".join(lines), flush=True)
                 final_state["emitted"] = True
                 done_event.set()
                 return
+            diag("knob_retry_failed", rc=proc.returncode)
             log(f"fallback bench rc={proc.returncode} — keeping the "
                 f"parent's record")
         except subprocess.TimeoutExpired:
+            diag("knob_retry_timeout", timeout_s=budget + 300)
             log("fallback bench timed out — keeping the parent's record")
 
     # LAST line is the headline (the driver parses the final JSON line),

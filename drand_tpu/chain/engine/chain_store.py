@@ -15,6 +15,7 @@ from dataclasses import dataclass
 from ...crypto import batch
 from ...net.packets import PartialBeaconPacket
 from ...net.transport import ProtocolClient
+from ...obs.trace import TRACER
 from ...utils.logging import KVLogger
 from .. import beacon as chain_beacon
 from .. import time_math
@@ -103,10 +104,20 @@ class ChainStore(CallbackStore):
             self._l.debug("aggregator", "ignoring_partial", round=p_round,
                           last=last.round)
             return last
+        with TRACER.activate(round_no=p_round,
+                             chain=self._crypto.chain_info.genesis_seed):
+            return self._process_partial(partial, cache, last)
+
+    def _process_partial(self, partial: _PartialInfo, cache: PartialCache,
+                         last: Beacon) -> Beacon:
+        p_round = partial.p.round
         group = self._crypto.get_group()
         thr, n = group.threshold, len(group)
-        cache.append(partial.p)
-        rc = cache.get_round_cache(p_round, partial.p.previous_sig)
+        with TRACER.span("collect", sender=partial.addr) as sp:
+            cache.append(partial.p)
+            rc = cache.get_round_cache(p_round, partial.p.previous_sig)
+            if rc is not None:
+                sp.attrs.update(have=len(rc), threshold=thr)
         if rc is None:
             self._l.error("aggregator", "no_round_cache", round=p_round)
             return last
@@ -173,7 +184,10 @@ class ChainStore(CallbackStore):
         if last.round + 1 != new_beacon.round:
             return False
         try:
-            self.put(new_beacon)
+            # store span covers the append AND the callback fan-out
+            # (DiscrepancyStore gauges, sync streams, transitions)
+            with TRACER.span("store", v2=new_beacon.is_v2()):
+                self.put(new_beacon)
         except StoreError as e:
             self._l.error("aggregator", "error_storing", err=str(e))
             return False

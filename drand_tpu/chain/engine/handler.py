@@ -16,6 +16,7 @@ from ...key.group import Group
 from ...key.keys import Node, Share
 from ...net.packets import PartialBeaconPacket, SyncRequest
 from ...net.transport import ProtocolClient, ProtocolService, TransportError
+from ...obs.trace import TRACER
 from ...utils.clock import Clock
 from ...utils.logging import KVLogger
 from .. import beacon as chain_beacon
@@ -149,31 +150,35 @@ class Handler(ProtocolService):
                           last=last_round)
             raise TransportError(
                 f"stale round: {p.round} (chain at {last_round})")
-        msg = chain_beacon.message(p.round, p.previous_sig)
-        pub = self.crypto.get_pub()
-        if not tbls.verify_partial(pub, msg, p.partial_sig):
-            self._l.error("process_partial", from_addr, err="invalid partial sig",
-                          round=p.round)
-            raise TransportError("invalid partial signature")
-        if p.partial_sig_v2:
-            # both partials must come from the same share index: otherwise a
-            # malicious member can pair its own V1 partial with a replayed
-            # honest V2 partial, inflating the V2 count with duplicate
-            # embedded indices and vetoing rounds (reference node.go:121-130
-            # lacks this check — fixed here).
-            if tbls.index_of(p.partial_sig_v2) != tbls.index_of(p.partial_sig):
-                self._l.error("process_partial_v2", from_addr,
-                              err="v1/v2 index mismatch", round=p.round)
-                raise TransportError("partial signature index mismatch")
-            msg_v2 = chain_beacon.message_v2(p.round)
-            if not tbls.verify_partial(pub, msg_v2, p.partial_sig_v2):
-                self._l.error("process_partial_v2", from_addr,
-                              err="invalid partial sig v2", round=p.round)
-                raise TransportError("invalid partial signature v2")
-        if tbls.index_of(p.partial_sig) == self.crypto.index():
-            # a reflected copy of our own partial: ignore
-            return
-        self.chain.new_valid_partial(from_addr, p)
+        with TRACER.activate(round_no=p.round,
+                             chain=self.crypto.chain_info.genesis_seed), \
+                TRACER.span("partial_verify", node=self.addr,
+                            sender=from_addr):
+            msg = chain_beacon.message(p.round, p.previous_sig)
+            pub = self.crypto.get_pub()
+            if not tbls.verify_partial(pub, msg, p.partial_sig):
+                self._l.error("process_partial", from_addr,
+                              err="invalid partial sig", round=p.round)
+                raise TransportError("invalid partial signature")
+            if p.partial_sig_v2:
+                # both partials must come from the same share index:
+                # otherwise a malicious member can pair its own V1 partial
+                # with a replayed honest V2 partial, inflating the V2 count
+                # with duplicate embedded indices and vetoing rounds
+                # (reference node.go:121-130 lacks this check — fixed here).
+                if tbls.index_of(p.partial_sig_v2) != tbls.index_of(p.partial_sig):
+                    self._l.error("process_partial_v2", from_addr,
+                                  err="v1/v2 index mismatch", round=p.round)
+                    raise TransportError("partial signature index mismatch")
+                msg_v2 = chain_beacon.message_v2(p.round)
+                if not tbls.verify_partial(pub, msg_v2, p.partial_sig_v2):
+                    self._l.error("process_partial_v2", from_addr,
+                                  err="invalid partial sig v2", round=p.round)
+                    raise TransportError("invalid partial signature v2")
+            if tbls.index_of(p.partial_sig) == self.crypto.index():
+                # a reflected copy of our own partial: ignore
+                return
+            self.chain.new_valid_partial(from_addr, p)
 
     def sync_chain(self, from_addr: str, req: SyncRequest) -> AsyncIterator[Beacon]:
         return self.chain.sync.sync_chain(from_addr, req)
@@ -230,7 +235,13 @@ class Handler(ProtocolService):
                 p.cancel()
 
     async def _delayed_broadcast(self, upon: Beacon) -> None:
-        await self.conf.clock.sleep(self.conf.group.catchup_period)
+        # network recovering: the catchup-period breather before hurrying
+        # the next partial (node.go:256-271)
+        with TRACER.activate(round_no=upon.round + 1,
+                             chain=self.crypto.chain_info.genesis_seed), \
+                TRACER.span("breather", node=self.addr,
+                            catchup_period=self.conf.group.catchup_period):
+            await self.conf.clock.sleep(self.conf.group.catchup_period)
         if not self._stopped:
             await self._broadcast_next_partial(self._current_round, upon)
 
@@ -241,21 +252,27 @@ class Handler(ProtocolService):
             # we already have this round's beacon: re-broadcast it per spec
             previous_sig = upon.previous_sig
             round_no = current_round
-        msg = chain_beacon.message(round_no, previous_sig)
-        curr_sig = self.crypto.sign_partial(msg)
-        sig_v2 = self.crypto.sign_partial(chain_beacon.message_v2(round_no))
-        packet = PartialBeaconPacket(
-            round=round_no,
-            previous_sig=previous_sig,
-            partial_sig=curr_sig,
-            partial_sig_v2=sig_v2,
-        )
-        self._l.debug("broadcast_partial", round=round_no)
-        self.chain.new_valid_partial(self.addr, packet)
-        for node in self.crypto.get_group().nodes:
-            if node.address() == self.addr:
-                continue
-            asyncio.ensure_future(self._send_partial(node, packet))
+        with TRACER.activate(round_no=round_no,
+                             chain=self.crypto.chain_info.genesis_seed):
+            with TRACER.span("partial", node=self.addr):
+                msg = chain_beacon.message(round_no, previous_sig)
+                curr_sig = self.crypto.sign_partial(msg)
+                sig_v2 = self.crypto.sign_partial(
+                    chain_beacon.message_v2(round_no))
+                packet = PartialBeaconPacket(
+                    round=round_no,
+                    previous_sig=previous_sig,
+                    partial_sig=curr_sig,
+                    partial_sig_v2=sig_v2,
+                )
+            self._l.debug("broadcast_partial", round=round_no)
+            self.chain.new_valid_partial(self.addr, packet)
+            # tasks created inside the activate block copy the trace
+            # context, so the outbound calls carry the traceparent
+            for node in self.crypto.get_group().nodes:
+                if node.address() == self.addr:
+                    continue
+                asyncio.ensure_future(self._send_partial(node, packet))
 
     async def _send_partial(self, node, packet: PartialBeaconPacket) -> None:
         try:

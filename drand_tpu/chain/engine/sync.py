@@ -12,6 +12,7 @@ from typing import AsyncIterator
 from ...crypto import batch
 from ...net.packets import SyncRequest
 from ...net.transport import ProtocolClient, TransportError
+from ...obs.trace import TRACER
 from ...utils.logging import KVLogger
 from ..beacon import Beacon
 from ..info import Info
@@ -114,7 +115,15 @@ class Syncer:
                 # over the reference, which skips this (sync.go:105) — the V2
                 # signature when present, so a malicious sync peer cannot
                 # poison the unchained signature (the timelock key).
-                oks = batch.verify_beacons(self._info.public_key, chunk)
+                # retain=False: catch-up streams thousands of historical
+                # rounds — they must feed the histograms without evicting
+                # live round timelines from the bounded ring
+                with TRACER.activate(round_no=chunk[-1].round,
+                                     chain=self._info.genesis_seed,
+                                     retain=False), \
+                        TRACER.span("sync_verify", chunk=len(chunk),
+                                    peer=_addr(peer)):
+                    oks = batch.verify_beacons(self._info.public_key, chunk)
                 for b, ok in zip(chunk, oks):
                     if not ok:
                         self._l.warn("syncer", "invalid_beacon", peer=_addr(peer),

@@ -13,7 +13,8 @@ through its localhost control port (cmd/drand-cli/control.go), exactly like
     python -m drand_tpu.cli get public --url http://host:port [--round R]
     python -m drand_tpu.cli get chain-info --url http://host:port
     python -m drand_tpu.cli show {share|group|chain-info|public|status} --control PORT
-    python -m drand_tpu.cli util {check|ping} ...
+    python -m drand_tpu.cli util {check|ping|trace} ...
+    python -m drand_tpu.cli util trace --url http://host:port [--n K]
     python -m drand_tpu.cli stop --control PORT
 """
 
@@ -173,7 +174,7 @@ def cmd_share(args) -> None:
                     old_group = None
                     if args.from_group:
                         # the daemon writes TOML group files; accept JSON too
-                        import tomllib
+                        from ..utils.toml_compat import tomllib
 
                         raw = open(args.from_group, "rb").read()
                         try:
@@ -314,7 +315,54 @@ def cmd_get(args) -> None:
     asyncio.run(run())
 
 
+def _print_trace_timeline(data: dict) -> None:
+    """Render /debug/trace/rounds JSON as per-round stage timelines."""
+    rounds = data.get("rounds", [])
+    if not rounds:
+        print("no round traces recorded yet")
+        return
+    for rec in rounds:
+        spans = sorted(rec.get("spans", []), key=lambda s: s["start"])
+        head = f"round {rec.get('round')}  trace {rec.get('trace_id')}"
+        if rec.get("dropped"):
+            head += f"  ({rec['dropped']} spans dropped)"
+        print(head)
+        t0 = spans[0]["start"] if spans else 0.0
+        for sp in spans:
+            off_ms = (sp["start"] - t0) * 1000.0
+            dur = sp.get("duration_ms") or 0.0
+            attrs = " ".join(f"{k}={v}" for k, v in
+                             (sp.get("attrs") or {}).items())
+            print(f"  +{off_ms:10.3f}ms  {sp['name']:<16}"
+                  f" {dur:10.3f}ms  {attrs}")
+        print()
+
+
 def cmd_util(args) -> None:
+    if args.what == "trace":
+        # fetch + pretty-print the round timeline of a running node
+        # (the always-on /debug/trace/rounds surface)
+        if not args.url:
+            raise SystemExit("util trace requires --url http://host:port")
+
+        async def run_trace():
+            import aiohttp
+
+            base = args.url.rstrip("/")
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"{base}/debug/trace/rounds",
+                                 params={"n": args.n}) as r:
+                    if r.status != 200:
+                        raise SystemExit(
+                            f"{base}/debug/trace/rounds -> HTTP {r.status}")
+                    data = await r.json()
+            if args.json:
+                print(json.dumps(data, indent=2))
+            else:
+                _print_trace_timeline(data)
+
+        asyncio.run(run_trace())
+        return
     if args.what == "del-beacon":
         # offline rollback (reference cli.go:651 deleteBeaconCmd): daemon
         # must be stopped; removes every round >= --round
@@ -645,13 +693,18 @@ def main(argv=None) -> None:
 
     u = sub.add_parser("util")
     u.add_argument("what", choices=["ping", "check", "del-beacon",
-                                    "self-sign", "reset"])
+                                    "self-sign", "reset", "trace"])
     u.add_argument("--control", type=int, default=8888)
     u.add_argument("--address")
     u.add_argument("--folder")
     u.add_argument("--round", type=int, default=None)
     u.add_argument("--force", action="store_true",
                    help="confirm destructive util commands (reset)")
+    u.add_argument("--url", help="public HTTP base URL (trace)")
+    u.add_argument("--n", type=int, default=8,
+                   help="round timelines to fetch (trace)")
+    u.add_argument("--json", action="store_true",
+                   help="raw JSON instead of the pretty timeline (trace)")
     u.set_defaults(fn=cmd_util)
 
     r = sub.add_parser("relay")

@@ -21,6 +21,7 @@ implementation is the semantics oracle.
 from __future__ import annotations
 
 import os
+import time as _time
 
 import numpy as np
 
@@ -48,6 +49,46 @@ def _note_fallback(op: str, err: Exception) -> None:
 
         default_logger("batch").warn(
             "engine", "device_fallback", op=op, err=repr(err))
+
+
+def _note_dispatch(op: str) -> None:
+    """Count every batched device-engine dispatch (engine_device_batches;
+    failures additionally count in engine_device_fallbacks)."""
+    from .. import metrics
+
+    metrics.ENGINE_BATCHES.labels(op=op).inc()
+
+
+class _timed:
+    """Observe engine_op_seconds{op,path,batch} around one dispatch —
+    the per-op device-vs-host latency surface. Failed dispatches are
+    recorded under ``path="<path>_error"`` so a wedged device's timeout
+    samples don't masquerade as real device latency (the host-fallback
+    call then contributes its own, separate, sample). Semantic
+    rejections — ValueError, this module's documented "no fallback"
+    convention (e.g. below-threshold recover) — land under
+    ``<path>_invalid`` instead: an instant raise in the _error series
+    would page operators alerting on wedged-device signals for a
+    routine degraded round."""
+
+    def __init__(self, op: str, path: str, n: int):
+        self._labels = (op, path, n)
+
+    def __enter__(self):
+        self._t0 = _time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        op, path, n = self._labels
+        if exc_type is not None:
+            path += ("_invalid" if issubclass(exc_type, ValueError)
+                     else "_error")
+        from .. import metrics
+
+        metrics.ENGINE_OP_SECONDS.labels(
+            op=op, path=path, batch=metrics.batch_bucket(n)).observe(
+            _time.perf_counter() - self._t0)
+        return False
 
 
 def configure(mode: str, min_batch: int | None = None, engine=None) -> None:
@@ -130,18 +171,21 @@ def verify_beacons(pubkey: PointG1, beacons,
 
     if _use_device(len(beacons)):
         try:
-            return engine().verify_beacons(pubkey, beacons, dst)
+            _note_dispatch("verify_beacons")
+            with _timed("verify_beacons", "device", len(beacons)):
+                return engine().verify_beacons(pubkey, beacons, dst)
         except Exception as e:  # noqa: BLE001 — host path is the oracle
             if _MODE == "device":
                 raise
             _note_fallback("verify_beacons", e)
-    out = np.zeros(len(beacons), dtype=bool)
-    for i, b in enumerate(beacons):
-        ok = chain_beacon.verify_beacon(pubkey, b)
-        if ok and b.is_v2():
-            ok = chain_beacon.verify_beacon_v2(pubkey, b)
-        out[i] = ok
-    return out
+    with _timed("verify_beacons", "host", len(beacons)):
+        out = np.zeros(len(beacons), dtype=bool)
+        for i, b in enumerate(beacons):
+            ok = chain_beacon.verify_beacon(pubkey, b)
+            if ok and b.is_v2():
+                ok = chain_beacon.verify_beacon_v2(pubkey, b)
+            out[i] = ok
+        return out
 
 
 def verify_partials(pub_poly: PubPoly, msg: bytes, partials,
@@ -150,12 +194,15 @@ def verify_partials(pub_poly: PubPoly, msg: bytes, partials,
     chain/beacon/node.go:112, batched)."""
     if _use_device(len(partials)):
         try:
-            return engine().verify_partials(pub_poly, msg, partials, dst)
+            _note_dispatch("verify_partials")
+            with _timed("verify_partials", "device", len(partials)):
+                return engine().verify_partials(pub_poly, msg, partials, dst)
         except Exception as e:  # noqa: BLE001
             if _MODE == "device":
                 raise
             _note_fallback("verify_partials", e)
-    return [tbls.verify_partial(pub_poly, msg, p, dst) for p in partials]
+    with _timed("verify_partials", "host", len(partials)):
+        return [tbls.verify_partial(pub_poly, msg, p, dst) for p in partials]
 
 
 def verify_recovered_many(pubkey: PointG1, pairs,
@@ -164,12 +211,15 @@ def verify_recovered_many(pubkey: PointG1, pairs,
     re-verification becomes one call (chain/beacon/chain.go:141,159)."""
     if _use_device(len(pairs)):
         try:
-            return engine().verify_sigs(pubkey, pairs, dst)
+            _note_dispatch("verify_recovered_many")
+            with _timed("verify_recovered_many", "device", len(pairs)):
+                return engine().verify_sigs(pubkey, pairs, dst)
         except Exception as e:  # noqa: BLE001
             if _MODE == "device":
                 raise
             _note_fallback("verify_recovered_many", e)
-    return [tbls.verify_recovered(pubkey, m, s, dst) for m, s in pairs]
+    with _timed("verify_recovered_many", "host", len(pairs)):
+        return [tbls.verify_recovered(pubkey, m, s, dst) for m, s in pairs]
 
 
 def recover(pub_poly: PubPoly, msg: bytes, partials, t: int, n: int,
@@ -178,14 +228,17 @@ def recover(pub_poly: PubPoly, msg: bytes, partials, t: int, n: int,
     chain/beacon/chain.go:136). Device MSM for large thresholds."""
     if _use_device(t):
         try:
-            return engine().recover(pub_poly, msg, partials, t, n, dst)
+            _note_dispatch("recover")
+            with _timed("recover", "device", t):
+                return engine().recover(pub_poly, msg, partials, t, n, dst)
         except ValueError:
             raise  # semantic error (not enough partials): no fallback
         except Exception as e:  # noqa: BLE001
             if _MODE == "device":
                 raise
             _note_fallback("recover", e)
-    return tbls.recover(pub_poly, msg, partials, t, n, dst)
+    with _timed("recover", "host", t):
+        return tbls.recover(pub_poly, msg, partials, t, n, dst)
 
 
 def aggregate_round(pub_poly: PubPoly, msg: bytes, partials, t: int, n: int,
@@ -201,28 +254,41 @@ def aggregate_round(pub_poly: PubPoly, msg: bytes, partials, t: int, n: int,
     on ingress (the daemon's handler path) — the host fallback then skips
     the per-partial pairings (the fused device graph re-verifies anyway,
     at zero extra dispatches)."""
+    from ..obs.trace import TRACER
+
     if _use_device(len(partials)):
         try:
-            return engine().aggregate_round(pub_poly, msg, partials, t, n,
-                                            dst)
+            _note_dispatch("aggregate_round")
+            # the fused dispatch recovers AND verifies in one executable:
+            # the whole call is the round's "recover" stage
+            with TRACER.span("recover", path="device", fused=True,
+                             partials=len(partials)), \
+                    _timed("aggregate_round", "device", len(partials)):
+                return engine().aggregate_round(pub_poly, msg, partials,
+                                                t, n, dst)
         except ValueError:
             raise  # semantic error: no fallback
         except Exception as e:  # noqa: BLE001
             if _MODE == "device":
                 raise
             _note_fallback("aggregate_round", e)
-    if prevalidated:
-        oks = [len(p) == tbls.PARTIAL_SIG_SIZE for p in partials]
-    else:
-        oks = [tbls.verify_partial(pub_poly, msg, p, dst) for p in partials]
-    good = [p for p, ok in zip(partials, oks) if ok]
-    if len(good) < t:
-        raise ValueError(f"not enough valid partials: {len(good)} < {t}")
-    sig = tbls.recover(pub_poly, msg, good, t, n, dst)
-    if not tbls.verify_recovered(pub_poly.commit(), msg, sig, dst):
-        raise tbls.RecoveredSignatureInvalid(
-            "recovered signature failed verification")
-    return oks, sig
+    with _timed("aggregate_round", "host", len(partials)):
+        if prevalidated:
+            oks = [len(p) == tbls.PARTIAL_SIG_SIZE for p in partials]
+        else:
+            with TRACER.span("verify", what="partials", n=len(partials)):
+                oks = [tbls.verify_partial(pub_poly, msg, p, dst)
+                       for p in partials]
+        good = [p for p, ok in zip(partials, oks) if ok]
+        if len(good) < t:
+            raise ValueError(f"not enough valid partials: {len(good)} < {t}")
+        with TRACER.span("recover", path="host", partials=len(good)):
+            sig = tbls.recover(pub_poly, msg, good, t, n, dst)
+        with TRACER.span("verify", what="recovered"):
+            if not tbls.verify_recovered(pub_poly.commit(), msg, sig, dst):
+                raise tbls.RecoveredSignatureInvalid(
+                    "recovered signature failed verification")
+        return oks, sig
 
 
 def eval_commits(polys: list[PubPoly], index: int) -> list[PointG1]:
@@ -231,9 +297,12 @@ def eval_commits(polys: list[PubPoly], index: int) -> list[PointG1]:
     once (BASELINE config "n=128 deal verify"; kyber vss VerifyDeal)."""
     if _use_device(len(polys)):
         try:
-            return engine().eval_commits(polys, index)
+            _note_dispatch("eval_commits")
+            with _timed("eval_commits", "device", len(polys)):
+                return engine().eval_commits(polys, index)
         except Exception as e:  # noqa: BLE001
             if _MODE == "device":
                 raise
             _note_fallback("eval_commits", e)
-    return [p.eval(index).value for p in polys]
+    with _timed("eval_commits", "host", len(polys)):
+        return [p.eval(index).value for p in polys]

@@ -5,22 +5,66 @@ Mirrors kyber/encrypt/ecies as used by the reference
 ephemeral ECDH on G1, HKDF-SHA256 key derivation, AES-256-GCM AEAD.
 
 Ciphertext layout: 48-byte compressed ephemeral G1 point || GCM sealed box.
+
+When the ``cryptography`` package is missing (minimal images), a
+self-contained AEAD stands in for AES-GCM: SHA256-CTR keystream +
+HMAC-SHA256 tag over the same HKDF-derived key/nonce. The KDF is
+bit-identical to the library HKDF (RFC 5869), but the sealed box is NOT
+wire-compatible with AES-GCM peers — every node of a group must run the
+same build, which the DKG deployment already requires.
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import secrets
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
-from cryptography.hazmat.primitives import hashes
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+    from cryptography.hazmat.primitives import hashes
+except ModuleNotFoundError:  # gated: fallback AEAD below
+    AESGCM = None
 
 from .fields import R
 from .curves import PointG1
 
 _KEY_LEN = 32
 _NONCE_LEN = 12
+_TAG_LEN = 16
 EPH_SIZE = PointG1.COMPRESSED_SIZE
+
+
+def _hkdf_sha256(ikm: bytes, length: int) -> bytes:
+    """RFC 5869 HKDF-SHA256, salt=None, info=b"" — same output as the
+    ``cryptography`` HKDF used on the main path."""
+    prk = hmac.new(b"\x00" * 32, ikm, hashlib.sha256).digest()
+    okm, t, i = b"", b"", 1
+    while len(okm) < length:
+        t = hmac.new(prk, t + bytes([i]), hashlib.sha256).digest()
+        okm += t
+        i += 1
+    return okm[:length]
+
+
+_FALLBACK_WARNED = False
+
+
+def _warn_fallback() -> None:
+    """One-time notice that the non-wire-compatible AEAD is active, so a
+    mixed-build group's decrypt failures are diagnosable from THIS node
+    (the peer only ever sees 'invalid tag')."""
+    global _FALLBACK_WARNED
+    if _FALLBACK_WARNED:
+        return
+    _FALLBACK_WARNED = True
+    from ..utils.logging import default_logger
+
+    default_logger("ecies").warn(
+        "ecies", "aead_fallback_active",
+        reason="'cryptography' package missing: using SHA256-CTR/HMAC "
+               "AEAD, not wire-compatible with AES-GCM peers")
 
 
 def _derive(dh: PointG1) -> tuple[bytes, bytes]:
@@ -33,11 +77,61 @@ def _derive(dh: PointG1) -> tuple[bytes, bytes]:
     return okm[:_KEY_LEN], okm[_KEY_LEN:]
 
 
+def _derive_fallback(dh: PointG1) -> tuple[bytes, bytes, bytes]:
+    """(enc_key, mac_key, nonce) for the fallback AEAD — encryption and
+    MAC keys are INDEPENDENT HKDF outputs (encrypt-then-MAC's security
+    argument requires that; reusing one key for both would rest on an
+    unanalyzed interaction between the CTR and HMAC constructions)."""
+    _warn_fallback()
+    okm = _hkdf_sha256(dh.to_bytes(), 2 * _KEY_LEN + _NONCE_LEN)
+    return (okm[:_KEY_LEN], okm[_KEY_LEN:2 * _KEY_LEN],
+            okm[2 * _KEY_LEN:])
+
+
+def _keystream(key: bytes, nonce: bytes, n: int) -> bytes:
+    out = b""
+    ctr = 0
+    while len(out) < n:
+        out += hashlib.sha256(key + nonce + ctr.to_bytes(8, "big")).digest()
+        ctr += 1
+    return out[:n]
+
+
+def _fallback_seal(enc_key: bytes, mac_key: bytes, nonce: bytes,
+                   msg: bytes) -> bytes:
+    ct = bytes(a ^ b
+               for a, b in zip(msg, _keystream(enc_key, nonce, len(msg))))
+    tag = hmac.new(mac_key, nonce + ct, hashlib.sha256).digest()[:_TAG_LEN]
+    return ct + tag
+
+
+def _fallback_open(enc_key: bytes, mac_key: bytes, nonce: bytes,
+                   sealed: bytes) -> bytes:
+    ct, tag = sealed[:-_TAG_LEN], sealed[-_TAG_LEN:]
+    want = hmac.new(mac_key, nonce + ct, hashlib.sha256).digest()[:_TAG_LEN]
+    if not hmac.compare_digest(tag, want):
+        # the sealed-box layout carries no algorithm tag (it must stay
+        # byte-compatible with the reference when AES-GCM is present),
+        # so a peer sealing with AES-GCM against our fallback AEAD is
+        # indistinguishable from corruption — name the likely cause
+        raise ValueError(
+            "ECIES decryption failed: invalid tag (this build lacks the "
+            "'cryptography' package and uses the fallback AEAD, which is "
+            "not wire-compatible with AES-GCM peers)")
+    return bytes(a ^ b
+                 for a, b in zip(ct, _keystream(enc_key, nonce, len(ct))))
+
+
 def encrypt(public: PointG1, msg: bytes) -> bytes:
     r = secrets.randbelow(R - 1) + 1
     eph = PointG1.generator().mul(r)
-    key, nonce = _derive(public.mul(r))
-    sealed = AESGCM(key).encrypt(nonce, msg, None)
+    dh = public.mul(r)
+    if AESGCM is not None:
+        key, nonce = _derive(dh)
+        sealed = AESGCM(key).encrypt(nonce, msg, None)
+    else:
+        enc_key, mac_key, nonce = _derive_fallback(dh)
+        sealed = _fallback_seal(enc_key, mac_key, nonce, msg)
     return eph.to_bytes() + sealed
 
 
@@ -46,8 +140,12 @@ def decrypt(sk: int, ciphertext: bytes) -> bytes:
     if len(ciphertext) < EPH_SIZE + 16:
         raise ValueError("ciphertext too short")
     eph = PointG1.from_bytes(ciphertext[:EPH_SIZE])
-    key, nonce = _derive(eph.mul(sk))
-    try:
-        return AESGCM(key).decrypt(nonce, ciphertext[EPH_SIZE:], None)
-    except Exception as e:  # InvalidTag
-        raise ValueError(f"ECIES decryption failed: {e}") from e
+    dh = eph.mul(sk)
+    if AESGCM is not None:
+        key, nonce = _derive(dh)
+        try:
+            return AESGCM(key).decrypt(nonce, ciphertext[EPH_SIZE:], None)
+        except Exception as e:  # InvalidTag
+            raise ValueError(f"ECIES decryption failed: {e}") from e
+    enc_key, mac_key, nonce = _derive_fallback(dh)
+    return _fallback_open(enc_key, mac_key, nonce, ciphertext[EPH_SIZE:])

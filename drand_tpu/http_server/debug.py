@@ -9,6 +9,11 @@ stats, and — when jax is loaded — the JAX profiler for device traces.
     GET /debug/pprof/stacks              every thread's current stack
     GET /debug/gc                        run a collection, report counts
     GET /debug/jax/trace?seconds=2       JAX device trace -> path on disk
+
+The round-timeline endpoint is ALWAYS on (span recording is a dict
+append — there is no profiling cost to gate):
+
+    GET /debug/trace/rounds?n=K          last K round traces (obs/trace.py)
 """
 
 from __future__ import annotations
@@ -32,6 +37,23 @@ def add_debug_routes(app: web.Application) -> None:
         web.get("/debug/gc", _gc),
         web.get("/debug/jax/trace", _jax_trace),
     ])
+
+
+def add_trace_routes(app: web.Application) -> None:
+    app.add_routes([web.get("/debug/trace/rounds", _trace_rounds)])
+
+
+async def _trace_rounds(request: web.Request) -> web.Response:
+    """The last n completed round timelines from the in-process tracer
+    ring — `drand util trace` pretty-prints this payload."""
+    from ..obs.trace import TRACER
+
+    try:
+        n = int(request.query.get("n", "8"))
+    except ValueError:
+        return web.json_response({"error": "bad n"}, status=400)
+    n = max(1, min(n, TRACER.max_rounds))
+    return web.json_response({"rounds": TRACER.rounds(n)})
 
 
 _PROFILE_LOCK = asyncio.Lock()  # cProfile and the JAX tracer cannot nest

@@ -57,6 +57,7 @@ class PublicServer:
         self._latest: Result | None = None
         self._next_round_event = asyncio.Event()
         self._watch_task: asyncio.Task | None = None
+        self._chain_tag: bytes | None = None
         self.app = web.Application(middlewares=[self._instrument])
         self.app.add_routes([
             web.get("/public/latest", self._handle_latest),
@@ -66,7 +67,18 @@ class PublicServer:
             web.get("/metrics", self._handle_metrics),
             web.get("/peer/{addr}/metrics", self._handle_peer_metrics),
         ])
-        if enable_pprof:  # opt-in like the reference (pprof.go WithProfile)
+        # the round-timeline surface is on by default (no profiling
+        # cost; group topology is already public via /info and the
+        # group file) but operators can opt out with
+        # DRAND_TPU_TRACE_DEBUG=0; the pprof routes stay opt-in like
+        # the reference (pprof.go WithProfile)
+        import os
+
+        if os.environ.get("DRAND_TPU_TRACE_DEBUG", "1") != "0":
+            from .debug import add_trace_routes
+
+            add_trace_routes(self.app)
+        if enable_pprof:
             from .debug import add_debug_routes
 
             add_debug_routes(self.app)
@@ -132,12 +144,28 @@ class PublicServer:
             return web.json_response({"error": str(e)}, status=502)
         return web.Response(body=body, content_type="text/plain")
 
+    async def _result_response(self, r: Result) -> web.Response:
+        """Beacon JSON + the round-correlation id as an HTTP header, so a
+        consumer can join the response to /debug/trace and the KV logs."""
+        resp = web.json_response(result_json(r))
+        try:
+            from ..obs import trace as obs_trace
+
+            if self._chain_tag is None:
+                self._chain_tag = (await self._client.info()).genesis_seed
+            resp.headers[obs_trace.TRACEPARENT_HEADER] = \
+                obs_trace.make_traceparent(
+                    obs_trace.round_trace_id(r.round, self._chain_tag))
+        except Exception:  # noqa: BLE001 — the header is best-effort
+            pass
+        return resp
+
     async def _handle_latest(self, request: web.Request) -> web.Response:
         try:
             r = await self._client.get(0)
         except ClientError as e:
             return web.json_response({"error": str(e)}, status=404)
-        return web.json_response(result_json(r))
+        return await self._result_response(r)
 
     async def _handle_round(self, request: web.Request) -> web.Response:
         try:
@@ -145,7 +173,7 @@ class PublicServer:
         except ValueError:
             return web.json_response({"error": "bad round"}, status=400)
         try:
-            return web.json_response(result_json(await self._client.get(round_no)))
+            return await self._result_response(await self._client.get(round_no))
         except ClientError:
             pass
         # long-poll ONLY the upcoming round (server.go:102); a missing
@@ -166,7 +194,7 @@ class PublicServer:
         except asyncio.TimeoutError:
             pass  # fall through: the round may have landed regardless
         try:
-            return web.json_response(result_json(await self._client.get(round_no)))
+            return await self._result_response(await self._client.get(round_no))
         except ClientError as e:
             return web.json_response({"error": str(e)}, status=404)
 

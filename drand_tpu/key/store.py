@@ -9,7 +9,8 @@ dist_key.private, drand_group.toml) so operators find familiar layouts.
 from __future__ import annotations
 
 import os
-import tomllib
+
+from ..utils.toml_compat import tomllib
 
 from ..crypto.curves import PointG1
 from ..crypto.poly import PriShare

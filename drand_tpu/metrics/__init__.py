@@ -24,8 +24,13 @@ Catalogue parity (reference name → here):
   client_request_duration_seconds→ client_request_duration_seconds [client]
   (client_dns/tls_duration_seconds are Go httptrace hooks with no
    asyncio equivalent — intentionally absent)
-Additions beyond the reference (the TPU engine):
+Additions beyond the reference (the TPU engine + round tracing):
   engine_device_batches, engine_device_fallbacks, dkg_bundles_received
+  beacon_stage_seconds{stage}          [group]   per-stage round latency,
+      fed by the obs tracing spans (obs/trace.py) — partial, collect,
+      recover, verify, store, sync_verify, gossip_validate, breather
+  engine_op_seconds{op,path,batch}     [private] per-op device-vs-host
+      latency, batch-size-bucketed (crypto/batch.py dispatch wrappers)
 
 Everything is exposed on /metrics (render() gathers all four registries
 — the reference's handler chains its gatherers the same way,
@@ -115,6 +120,31 @@ ENGINE_BATCHES = Counter(
 ENGINE_FALLBACKS = Counter(
     "engine_device_fallbacks", "Device-engine failures that fell back to host",
     registry=REGISTRY)
+
+# ---- round tracing (obs/trace.py) -----------------------------------------
+# Stage/op work spans sub-millisecond (host crypto on small groups) to
+# tens of seconds (cold-compile device dispatches) — the default
+# prometheus buckets start too coarse at the low end.
+_LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+BEACON_STAGE_SECONDS = Histogram(
+    "beacon_stage_seconds",
+    "Per-stage beacon round-lifecycle latency (obs tracing spans)",
+    ["stage"], registry=GROUP_REGISTRY, buckets=_LATENCY_BUCKETS)
+ENGINE_OP_SECONDS = Histogram(
+    "engine_op_seconds",
+    "Batched crypto op latency by path (device|host; failed dispatches "
+    "land under <path>_error) and batch bucket",
+    ["op", "path", "batch"], registry=REGISTRY, buckets=_LATENCY_BUCKETS)
+
+
+def batch_bucket(n: int) -> str:
+    """Coarse batch-size bucket label — bounded cardinality for
+    engine_op_seconds (matches the engine's compile-bucket scale)."""
+    for b in (1, 8, 32, 128, 512):
+        if n <= b:
+            return str(b)
+    return "512+"
 
 
 def render() -> bytes:

@@ -19,7 +19,8 @@ from __future__ import annotations
 
 import asyncio
 import json
-import tomllib
+
+from ..utils.toml_compat import tomllib
 
 import grpc
 import grpc.aio

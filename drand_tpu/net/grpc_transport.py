@@ -19,6 +19,7 @@ import grpc
 import grpc.aio
 
 from ..chain.beacon import Beacon
+from ..obs import trace as obs_trace
 from ..utils.logging import KVLogger, default_logger
 from . import protowire as pw
 from . import wire
@@ -113,20 +114,28 @@ class GrpcGateway:
             from .. import metrics
 
             metrics.API_CALLS.labels(method=name).inc()
-            try:
+            # adopt the caller's round-correlation id (W3C traceparent
+            # layout) so the callee's spans/logs stitch into the same
+            # cross-node timeline; malformed/absent metadata is a no-op
+            with obs_trace.TRACER.activate_traceparent(
+                    obs_trace.traceparent_from_context(context)):
                 try:
-                    msg, from_addr = wire.decode(request)
-                except wire.WireError:
-                    # dual-codec: a reference node speaks protobuf on the
-                    # same Protocol method names (protocol.proto:16-33) —
-                    # decode, convert to the native packet, reply protobuf
-                    return await self._pb_protocol(name, request, context)
-                return await method(msg, from_addr)
-            except (wire.WireError, pw.WireError) as e:
-                await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-            except (TransportError, PermissionError, ValueError) as e:
-                await context.abort(grpc.StatusCode.FAILED_PRECONDITION,
-                                    str(e))
+                    try:
+                        msg, from_addr = wire.decode(request)
+                    except wire.WireError:
+                        # dual-codec: a reference node speaks protobuf on
+                        # the same Protocol method names
+                        # (protocol.proto:16-33) — decode, convert to the
+                        # native packet, reply protobuf
+                        return await self._pb_protocol(name, request,
+                                                       context)
+                    return await method(msg, from_addr)
+                except (wire.WireError, pw.WireError) as e:
+                    await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                        str(e))
+                except (TransportError, PermissionError, ValueError) as e:
+                    await context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                                        str(e))
         return handler
 
     async def _pb_protocol(self, name: str, request: bytes, context):
@@ -428,7 +437,8 @@ class GrpcClient(ProtocolClient):
         fn = ch.unary_unary(f"/{SERVICE}/{method}")
         try:
             return await fn(wire.encode(msg, from_addr=self._addr),
-                            timeout=self._timeout)
+                            timeout=self._timeout,
+                            metadata=obs_trace.outbound_metadata())
         except grpc.aio.AioRpcError as e:
             from .. import metrics
 

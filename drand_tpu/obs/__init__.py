@@ -1,0 +1,25 @@
+"""Observability: the round-lifecycle tracing subsystem (obs/trace.py).
+
+Import surface:
+    from drand_tpu.obs import trace
+    with trace.TRACER.activate(round_no=r, chain=seed):
+        with trace.TRACER.span("collect", have=3):
+            ...
+"""
+
+from . import trace  # noqa: F401
+from .trace import (  # noqa: F401
+    TRACEPARENT_HEADER,
+    TRACER,
+    Span,
+    Tracer,
+    current_round,
+    current_trace_id,
+    make_traceparent,
+    outbound_metadata,
+    parse_traceparent,
+    round_trace_id,
+    traceparent,
+    traceparent_from,
+    traceparent_from_context,
+)

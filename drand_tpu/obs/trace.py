@@ -1,0 +1,333 @@
+"""Round-lifecycle tracing: spans, a per-process ring of round timelines,
+and W3C-``traceparent``-style cross-node correlation.
+
+Modeled on upstream drand's later OpenTelemetry instrumentation
+(metrics/otel.go in recent drand) but self-contained — no OTel SDK in
+this image, and the beacon pipeline needs only three primitives:
+
+- :class:`Span`: one named, timed stage (``partial``, ``collect``,
+  ``recover``, ``verify``, ``store``, ...) with free-form attributes.
+- :class:`Tracer`: ``contextvars``-scoped span stack + a bounded
+  per-process ring buffer of completed *round* traces. Every span
+  closure also feeds the ``beacon_stage_seconds{stage=...}`` Prometheus
+  histogram, so continuous stage timing is visible from any running
+  daemon independent of the bench driver.
+- round-correlation ids: the trace id of round *r* on chain *c* is
+  ``blake2b(c || r)`` — DETERMINISTIC, so every node of a group derives
+  the same id for the same round and one round's timeline can be
+  stitched across nodes without any coordination. The id still travels
+  as an ``x-drand-traceparent`` header/metadata entry (gRPC + HTTP) in
+  the W3C ``00-<trace>-<span>-01`` layout so foreign hops (relays,
+  clients) can adopt it verbatim.
+
+The tracer is deliberately cheap: span open/close is a dict append under
+a lock, no I/O, no sampling machinery. Spans recorded outside any active
+trace context (e.g. client-side verification) are timed into the
+histograms but NOT retained in the ring — the ring holds round
+timelines only.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import hashlib
+import os
+import threading
+import time
+from collections import OrderedDict
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+TRACEPARENT_HEADER = "x-drand-traceparent"
+_VERSION = "00"
+_FLAGS = "01"
+
+# (trace_id: str, round_no: int | None, retain: bool) of the active
+# round trace; retain=False contexts feed histograms and logs but may
+# not CREATE ring entries (bulk-historical traffic like sync catch-up
+# must not evict live round timelines)
+_ctx_trace: contextvars.ContextVar = contextvars.ContextVar(
+    "drand_trace", default=None)
+# span id of the innermost open span (parent for new spans)
+_ctx_span: contextvars.ContextVar = contextvars.ContextVar(
+    "drand_span", default=None)
+
+
+def round_trace_id(round_no: int, chain: bytes | str = b"") -> str:
+    """Deterministic 16-byte trace id for (chain, round) — every group
+    member computes the same id, which is what makes cross-node
+    stitching free."""
+    if isinstance(chain, str):
+        chain = chain.encode()
+    h = hashlib.blake2b(chain + b"|drand-round|%d" % round_no,
+                        digest_size=16)
+    return h.hexdigest()
+
+
+def make_traceparent(trace_id: str, span_id: str | None = None) -> str:
+    """W3C traceparent: 00-<32 hex>-<16 hex>-01."""
+    return f"{_VERSION}-{trace_id}-{span_id or '0' * 16}-{_FLAGS}"
+
+
+_HEX = frozenset("0123456789abcdef")
+
+
+def parse_traceparent(header: str | None) -> tuple[str, str] | None:
+    """-> (trace_id, parent_span_id), or None on anything malformed
+    (ingress headers are untrusted). Strict lowercase hex per W3C —
+    int(x, 16) would admit '0x'/sign/'_' forms, letting a peer inject
+    ids that can't match any legitimately derived one into logs and
+    the /debug/trace ring."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) != 4:
+        return None
+    ver, tid, sid, _flags = parts
+    if len(ver) != 2 or len(tid) != 32 or len(sid) != 16:
+        return None
+    if not (_HEX.issuperset(tid) and _HEX.issuperset(sid)):
+        return None
+    return tid, sid
+
+
+def current_trace_id() -> str | None:
+    ctx = _ctx_trace.get()
+    return ctx[0] if ctx else None
+
+
+def current_round() -> int | None:
+    ctx = _ctx_trace.get()
+    return ctx[1] if ctx else None
+
+
+def traceparent() -> str | None:
+    """Header value for the active trace context (None when inactive)."""
+    ctx = _ctx_trace.get()
+    if ctx is None:
+        return None
+    return make_traceparent(ctx[0], _ctx_span.get())
+
+
+def outbound_metadata() -> tuple | None:
+    """gRPC-metadata pairs carrying the active correlation id, or None
+    when no trace context is active — shared by every egress hop."""
+    tp = traceparent()
+    if tp is None:
+        return None
+    return ((TRACEPARENT_HEADER, tp),)
+
+
+def traceparent_from(metadata) -> str | None:
+    """The traceparent entry of an iterable of (key, value) pairs.
+    Never raises — ingress metadata is untrusted and tracing must never
+    break an RPC."""
+    try:
+        for k, v in metadata or ():
+            if str(k).lower() == TRACEPARENT_HEADER:
+                return v
+    except Exception:  # noqa: BLE001
+        pass
+    return None
+
+
+def traceparent_from_context(context) -> str | None:
+    """The traceparent entry of a gRPC server call's invocation
+    metadata; never raises (shared by the protocol gateway and the
+    gossip relay so the guard cannot drift)."""
+    try:
+        md = context.invocation_metadata()
+    except Exception:  # noqa: BLE001
+        return None
+    return traceparent_from(md)
+
+
+def _new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+@dataclass
+class Span:
+    """One completed-or-open stage of a round's lifecycle."""
+
+    name: str
+    trace_id: str | None
+    span_id: str
+    parent_id: str | None
+    start: float                       # wall clock (time.time())
+    t0: float                          # perf counter, for the duration
+    end: float | None = None
+    duration_ms: float | None = None
+    attrs: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start": self.start,
+            "end": self.end,
+            "duration_ms": self.duration_ms,
+            "attrs": {k: _jsonable(v) for k, v in self.attrs.items()},
+        }
+
+
+def _jsonable(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, bytes):
+        return v.hex()
+    return str(v)
+
+
+class Tracer:
+    """Span factory + bounded ring of completed round traces.
+
+    ``max_rounds`` bounds the number of retained round timelines;
+    ``max_spans`` bounds each timeline (a pathological round — e.g. a
+    partial flood — must not grow memory without bound; overflow is
+    counted in the record's ``dropped`` field rather than silently
+    lost)."""
+
+    def __init__(self, max_rounds: int = 64, max_spans: int = 512):
+        self.max_rounds = max_rounds
+        self.max_spans = max_spans
+        self._lock = threading.Lock()
+        # trace_id -> {"trace_id","round","spans":[...],"dropped":int}
+        self._traces: OrderedDict[str, dict] = OrderedDict()
+
+    # ------------------------------------------------------------ context
+    @contextmanager
+    def activate(self, round_no: int | None = None, chain: bytes | str = b"",
+                 trace_id: str | None = None, retain: bool = True):
+        """Bind a round trace to the current (task) context; nested spans
+        and KV log lines pick it up automatically. ``retain=False``
+        spans still feed the histograms, carry the correlation id, and
+        append to an EXISTING ring entry, but never create one — bulk
+        historical traffic (sync catch-up) must not evict live round
+        timelines from the bounded ring."""
+        if trace_id is None:
+            if round_no is None:
+                raise ValueError("activate needs round_no or trace_id")
+            trace_id = round_trace_id(round_no, chain)
+        tok = _ctx_trace.set((trace_id, round_no, retain))
+        try:
+            yield trace_id
+        finally:
+            _ctx_trace.reset(tok)
+
+    @contextmanager
+    def activate_traceparent(self, header: str | None):
+        """Adopt a peer's traceparent header; a missing/malformed header
+        is a no-op passthrough (ingress is untrusted)."""
+        parsed = parse_traceparent(header)
+        if parsed is None:
+            yield None
+            return
+        tid, parent_span = parsed
+        tok_t = _ctx_trace.set((tid, None, True))
+        tok_s = _ctx_span.set(parent_span)
+        try:
+            yield tid
+        finally:
+            _ctx_span.reset(tok_s)
+            _ctx_trace.reset(tok_t)
+
+    # -------------------------------------------------------------- spans
+    @contextmanager
+    def span(self, name: str, **attrs):
+        """Open a stage span. On close: record it into the active round's
+        timeline (if any) and observe ``beacon_stage_seconds{stage=name}``.
+        The yielded Span is live — callers may update ``attrs``."""
+        ctx = _ctx_trace.get()
+        sp = Span(
+            name=name,
+            trace_id=ctx[0] if ctx else None,
+            span_id=_new_span_id(),
+            parent_id=_ctx_span.get(),
+            start=time.time(),
+            t0=time.perf_counter(),
+            attrs=attrs,
+        )
+        tok = _ctx_span.set(sp.span_id)
+        suffix = ""
+        try:
+            yield sp
+        except BaseException as e:
+            # failed stages must be distinguishable in the timeline
+            # (e.g. a wedged device dispatch before the host fallback)
+            sp.attrs.setdefault("error", True)
+            # ValueError is this codebase's semantic-rejection convention
+            # (below-threshold recover, malformed input) — an instant
+            # raise, not a wedged stage; same taxonomy as the
+            # batch-dispatch _timed wrapper's <path>_invalid. Task
+            # cancellation (daemon stop mid-breather) is routine, not a
+            # failure — it must not land in the *_error alert series.
+            if isinstance(e, ValueError):
+                suffix = "_invalid"
+            elif isinstance(e, asyncio.CancelledError):
+                suffix = "_cancelled"
+            else:
+                suffix = "_error"
+            raise
+        finally:
+            _ctx_span.reset(tok)
+            dur = time.perf_counter() - sp.t0
+            sp.end = time.time()
+            sp.duration_ms = dur * 1000.0
+            self._record(sp, ctx[1] if ctx else None,
+                         ctx[2] if ctx else True)
+            from .. import metrics
+
+            # failed stages land under stage="<name>_error" (or
+            # "<name>_invalid" for semantic rejections) so e.g. a wedged
+            # device dispatch's timeout doesn't masquerade as real
+            # recover latency (the host-fallback retry then contributes
+            # the round's real sample)
+            metrics.BEACON_STAGE_SECONDS.labels(
+                stage=name + suffix).observe(dur)
+
+    def _record(self, sp: Span, round_no: int | None,
+                retain: bool = True) -> None:
+        if sp.trace_id is None:
+            return  # no round context: histogram-only span
+        with self._lock:
+            rec = self._traces.get(sp.trace_id)
+            if rec is None:
+                if not retain:
+                    return  # histogram-only: never evict live timelines
+                rec = {"trace_id": sp.trace_id, "round": round_no,
+                       "spans": [], "dropped": 0}
+                self._traces[sp.trace_id] = rec
+                while len(self._traces) > self.max_rounds:
+                    self._traces.popitem(last=False)
+            elif rec.get("round") is None and round_no is not None:
+                rec["round"] = round_no
+            if len(rec["spans"]) >= self.max_spans:
+                rec["dropped"] += 1
+                return
+            rec["spans"].append(sp.to_dict())
+
+    # ------------------------------------------------------------- export
+    def rounds(self, n: int = 8) -> list[dict]:
+        """The last ``n`` round timelines, most recent first. Each entry:
+        ``{"trace_id", "round", "spans": [...], "dropped"}``."""
+        with self._lock:
+            recs = list(self._traces.values())[-n:] if n > 0 else []
+        out = []
+        for rec in reversed(recs):
+            out.append({"trace_id": rec["trace_id"], "round": rec["round"],
+                        "dropped": rec["dropped"],
+                        "spans": list(rec["spans"])})
+        return out
+
+    def reset(self) -> None:
+        """Drop all retained traces (tests)."""
+        with self._lock:
+            self._traces.clear()
+
+
+# The per-process tracer every instrumentation site shares (the ring is
+# per-process by design — ISSUE: continuous in-process stage timing).
+TRACER = Tracer()

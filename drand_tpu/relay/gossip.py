@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import os
 
 import grpc
 import grpc.aio
@@ -45,10 +46,15 @@ from ..chain.beacon import Beacon
 from ..chain.info import Info
 from ..client.interface import Client, ClientError, result_from_beacon
 from ..net import wire
+from ..obs import trace as obs_trace
 from ..utils.clock import Clock, SystemClock
 from ..utils.logging import KVLogger, default_logger
 
 SERVICE = "drand.Gossip"
+
+# per-process secret for the sender tags on the /debug/trace surface —
+# stable within a run (same peer -> same tag), worthless offline
+_SENDER_TAG_KEY = os.urandom(16)
 
 # scoring bounds (gossipsub v1.1 pruning analogue)
 SCORE_INVALID_LIMIT = 20   # validation-rejected deliveries before ban
@@ -178,13 +184,19 @@ class GossipNode(Client):
                          cooloff_s=EVICT_COOLOFF)
 
     # ---------------------------------------------------------- validation
-    def _validate(self, b: Beacon) -> bool:
+    def _max_live_round(self) -> int:
+        """Far-future drift bound (validator.go:16): the clock-expected
+        next round. Shared by _validate (reject beyond it) and the
+        trace-ring retain window in _accept, so the two cannot diverge."""
+        return time_math.current_round(int(self._clock.now()),
+                                       self.chain_info.period,
+                                       self.chain_info.genesis_time) + 1
+
+    def _validate(self, b: Beacon, max_live: int | None = None) -> bool:
         """lp2p/client/validator.go:16-69: reject far-future rounds and
         invalid signatures BEFORE caching or re-flooding."""
-        current = time_math.current_round(int(self._clock.now()),
-                                          self.chain_info.period,
-                                          self.chain_info.genesis_time)
-        if b.round > current + 1:
+        if b.round > (self._max_live_round() if max_live is None
+                      else max_live):
             return False
         ok = chain_beacon.verify_beacon(self.chain_info.public_key, b)
         if ok and b.is_v2():
@@ -200,8 +212,10 @@ class GossipNode(Client):
         if self._ip_banned(ip):
             await context.abort(grpc.StatusCode.PERMISSION_DENIED,
                                 "gossip: source is in eviction cooloff")
+        tp = obs_trace.traceparent_from_context(context)
         try:
-            await self._accept(request, validate=True, sender=ip)
+            with obs_trace.TRACER.activate_traceparent(tp):
+                await self._accept(request, validate=True, sender=ip)
         except wire.WireError as e:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         return b"{}"
@@ -214,7 +228,39 @@ class GossipNode(Client):
         msg, _ = wire.decode(raw)
         if not isinstance(msg, Beacon):
             raise wire.WireError("gossip: not a beacon")
-        if validate and not self._validate(msg):
+        # retain only the plausibly-live window — a replayed burst of
+        # historical beacons OR a flood of far-future invalid rounds
+        # (not yet validated here) must not evict live timelines from
+        # the ring. The lower bound is clock-derived, not just _tip:
+        # _tip starts at 0 on a fresh relay, and an ascending replay
+        # would keep it one round behind the burst
+        max_live = self._max_live_round()
+        ring_lo = max(self._tip, max_live - obs_trace.TRACER.max_rounds)
+        with obs_trace.TRACER.activate(
+                round_no=msg.round, chain=self.chain_info.genesis_seed,
+                retain=ring_lo <= msg.round <= max_live):
+            return await self._accept_beacon(msg, msg_id, raw, validate,
+                                             sender, max_live)
+
+    async def _accept_beacon(self, msg: Beacon, msg_id: bytes, raw: bytes,
+                             validate: bool, sender: str,
+                             max_live: int | None = None) -> None:
+        if validate:
+            # a stable per-process KEYED hash, not the raw peer IP: the
+            # span lands on the default-on /debug/trace surface, and
+            # mesh neighbors (unlike group members) are not public
+            # topology. The key blocks offline inversion — an unkeyed
+            # 4-byte digest of an IPv4 is brute-forceable in seconds
+            sender_tag = hashlib.blake2b(
+                sender.encode(), digest_size=4,
+                key=_SENDER_TAG_KEY).hexdigest()
+            with obs_trace.TRACER.span("gossip_validate", sender=sender_tag,
+                                       v2=msg.is_v2()) as sp:
+                ok = self._validate(msg, max_live)
+                sp.attrs["ok"] = ok
+        else:
+            ok = True
+        if not ok:
             # do NOT record rejected messages as seen: a beacon dropped for
             # clock skew must be acceptable when it arrives again later
             self._l.warn("gossip", "invalid_beacon_dropped", round=msg.round)
@@ -242,8 +288,12 @@ class GossipNode(Client):
         ch = st.channel
         if ch is None:
             return
+        # the forward task copied the accept-time trace context, so the
+        # round-correlation id rides the mesh hop as gRPC metadata
+        md = obs_trace.outbound_metadata()
         try:
-            await ch.unary_unary(f"/{SERVICE}/Publish")(raw, timeout=5.0)
+            await ch.unary_unary(f"/{SERVICE}/Publish")(raw, timeout=5.0,
+                                                        metadata=md)
             st.fails = 0
         except grpc.aio.AioRpcError as e:
             self._l.debug("gossip", "forward_failed", to=addr,

@@ -1,4 +1,9 @@
-"""Leveled key-value logger (reference: log/log.go — go-kit style kv pairs)."""
+"""Leveled key-value logger (reference: log/log.go — go-kit style kv pairs).
+
+Lines emitted inside an active round-trace context (obs/trace.py) carry
+``trace=<id> round=<r>`` automatically, so logs, metrics and the
+/debug/trace timeline all join on the same correlation key.
+"""
 
 from __future__ import annotations
 
@@ -7,10 +12,32 @@ import sys
 
 _FORMAT = "%(asctime)s %(levelname).1s %(name)s %(message)s"
 
+# Accepts the standard aliases; anything unknown falls back to info —
+# a bad config value must not crash daemon startup.
+_LEVELS = {
+    "none": logging.CRITICAL,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+}
+
 
 def _fmt_kv(args: tuple, kwargs: dict) -> str:
     parts = [str(a) for a in args]
     parts += [f"{k}={v}" for k, v in kwargs.items()]
+    try:
+        from ..obs import trace as _trace
+
+        tid = _trace.current_trace_id()
+        if tid is not None and "trace" not in kwargs:
+            parts.append(f"trace={tid}")
+            rnd = _trace.current_round()
+            if rnd is not None and "round" not in kwargs:
+                parts.append(f"round={rnd}")
+    except Exception:  # noqa: BLE001 — logging must never raise
+        pass
     return " ".join(parts)
 
 
@@ -38,7 +65,7 @@ class KVLogger:
 
 
 def default_logger(name: str = "drand", level: str = "info") -> KVLogger:
-    lvl = {"none": logging.CRITICAL, "info": logging.INFO, "debug": logging.DEBUG}[level]
+    lvl = _LEVELS.get(str(level).lower(), logging.INFO)
     root = logging.getLogger()
     if not root.handlers:
         h = logging.StreamHandler(sys.stderr)

@@ -1,0 +1,11 @@
+"""One resolved TOML parser for every caller: stdlib ``tomllib`` on
+Python 3.11+, the API-identical ``tomli`` below that (this image ships
+Python 3.10). Import the module object::
+
+    from ..utils.toml_compat import tomllib
+"""
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11
+    import tomli as tomllib  # noqa: F401

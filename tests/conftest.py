@@ -74,3 +74,18 @@ def pytest_configure(config):
 # `pytestmark = pytest.mark.device`; multi-process/scale tests carry
 # `pytest.mark.slow`. The documented fast path (README) is
 # `-m "not device and not slow"` (~3.5 min warm).
+
+
+def sample_count(registry, fam_name: str, **labels) -> float:
+    """Sum of _count/_total samples of a metric family matching the
+    given labels — shared by the metrics and tracing suites."""
+    total = 0.0
+    for fam in registry.collect():
+        if fam.name != fam_name:
+            continue
+        for s in fam.samples:
+            if not (s.name.endswith("_count") or s.name.endswith("_total")):
+                continue
+            if all(s.labels.get(k) == v for k, v in labels.items()):
+                total += s.value
+    return total

@@ -1,10 +1,14 @@
-"""Opt-in debug/profiling endpoints (metrics/pprof/pprof.go analogue)."""
+"""Opt-in debug/profiling endpoints (metrics/pprof/pprof.go analogue)
+and the always-on /debug/trace round-timeline surface (obs/trace.py)."""
+
+import logging
 
 import aiohttp
 import pytest
 
 from drand_tpu.client.direct import DirectClient
 from drand_tpu.http_server.server import PublicServer
+from drand_tpu.obs import trace
 from drand_tpu.testing.harness import BeaconTestNetwork
 
 
@@ -42,3 +46,65 @@ async def test_debug_routes_opt_in():
         await on.stop()
         await off.stop()
         net.stop_all()
+
+
+def _capture_harness_logs(caplog):
+    """The harness logs at level 'none'; raise every already-created
+    beacon-test logger to INFO so caplog sees the aggregator lines."""
+    for name in list(logging.root.manager.loggerDict):
+        if name.startswith("beacon-test"):
+            logging.getLogger(name).setLevel(logging.INFO)
+    caplog.set_level(logging.INFO)
+
+
+@pytest.mark.asyncio
+async def test_trace_rounds_timeline(caplog):
+    """ISSUE 1 acceptance: a harness round yields a /debug/trace/rounds
+    timeline with the named pipeline stages, on the SAME deterministic
+    trace id every node derives, and that id shows up in the KV logs."""
+    trace.TRACER.reset()
+    net = BeaconTestNetwork(n=3, t=2, period=5)
+    _capture_harness_logs(caplog)
+    await net.start_all()
+    await net.advance_to_genesis()
+    await net.clock.advance(5)
+    await net.wait_round(0, 1)
+    # /debug/trace is always on — no enable_pprof needed
+    server = PublicServer(DirectClient(net.nodes[0].handler),
+                         clock=net.clock)
+    site = await server.start("127.0.0.1", 0)
+    port = site._server.sockets[0].getsockname()[1]
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{port}"
+                             f"/debug/trace/rounds?n=4") as r:
+                assert r.status == 200
+                data = await r.json()
+            async with s.get(f"http://127.0.0.1:{port}"
+                             f"/debug/trace/rounds?n=zzz") as r:
+                assert r.status == 400
+            # the beacon response carries the round-correlation header
+            async with s.get(f"http://127.0.0.1:{port}/public/1") as r:
+                assert r.status == 200
+                parsed = trace.parse_traceparent(
+                    r.headers.get(trace.TRACEPARENT_HEADER))
+    finally:
+        await server.stop()
+        net.stop_all()
+
+    seed = net.group.get_genesis_seed()
+    by_round = {rec["round"]: rec for rec in data["rounds"]}
+    assert 1 in by_round
+    rec = by_round[1]
+    # all nodes derive the same id: the ring stitched their spans into
+    # one timeline keyed by round_trace_id(round, chain)
+    tid = trace.round_trace_id(1, seed)
+    assert rec["trace_id"] == tid
+    assert parsed is not None and parsed[0] == tid
+    stages = {sp["name"] for sp in rec["spans"]}
+    assert {"partial", "partial_verify", "collect",
+            "recover", "verify", "store"} <= stages
+    # spans carry real timing
+    assert all(sp["duration_ms"] is not None for sp in rec["spans"])
+    # the same correlation key appears on the aggregator's log lines
+    assert any(f"trace={tid}" in m for m in caplog.messages)

@@ -6,6 +6,7 @@ client/grpc/client.go (gRPC source), net/listener.go:108 + net/certs.go
 """
 
 import asyncio
+import importlib.util
 
 import pytest
 
@@ -108,6 +109,12 @@ async def test_grpc_public_stream():
         net.stop_all()
 
 
+_needs_cryptography = pytest.mark.skipif(
+    importlib.util.find_spec("cryptography") is None,
+    reason="self-signed cert generation needs the 'cryptography' package")
+
+
+@_needs_cryptography
 @pytest.mark.asyncio
 async def test_tls_transport_roundtrip(tmp_path):
     """Server under TLS; client trusts it only via the CertManager pool —
@@ -133,6 +140,7 @@ async def test_tls_transport_roundtrip(tmp_path):
         net.stop_all()
 
 
+@_needs_cryptography
 @pytest.mark.asyncio
 async def test_tls_multi_cert_pool_same_host(tmp_path):
     """Root pools holding SEVERAL self-signed node certs for the same

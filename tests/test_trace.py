@@ -1,0 +1,235 @@
+"""Round-lifecycle tracing (obs/trace.py): span nesting, ring bounds,
+cross-node correlation-id propagation, and log/metric correlation."""
+
+import asyncio
+import logging
+
+import pytest
+from conftest import sample_count
+
+from drand_tpu import metrics
+from drand_tpu.net.transport import LocalNetwork, ProtocolService
+from drand_tpu.obs import trace
+from drand_tpu.utils.logging import KVLogger, default_logger
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    trace.TRACER.reset()
+    yield
+    trace.TRACER.reset()
+
+
+def _stage_count(stage: str) -> float:
+    return sample_count(metrics.GROUP_REGISTRY, "beacon_stage_seconds",
+                        stage=stage)
+
+
+# ---------------------------------------------------------------- ids
+
+def test_round_trace_id_deterministic_across_nodes():
+    # every group member derives the same id for the same (chain, round)
+    a = trace.round_trace_id(7, b"seed")
+    b = trace.round_trace_id(7, b"seed")
+    assert a == b and len(a) == 32 and int(a, 16) >= 0
+    assert trace.round_trace_id(8, b"seed") != a
+    assert trace.round_trace_id(7, b"other-chain") != a
+
+
+def test_traceparent_roundtrip_and_malformed():
+    tid = trace.round_trace_id(3, b"c")
+    hdr = trace.make_traceparent(tid, "ab" * 8)
+    assert trace.parse_traceparent(hdr) == (tid, "ab" * 8)
+    for bad in (None, "", "00-zz-ff-01", "xx", "00-" + "0" * 32 + "-01",
+                "00-" + "g" * 32 + "-" + "0" * 16 + "-01",
+                # int(x, 16) laxness must not leak through: 0x / sign /
+                # underscore / uppercase forms are malformed per W3C
+                "00-0x" + "0" * 28 + "aa-" + "0" * 16 + "-01",
+                "00-+" + "0" * 31 + "-" + "0" * 16 + "-01",
+                "00-" + "0" * 30 + "_1-" + "0" * 16 + "-01",
+                "00-" + "A" * 32 + "-" + "0" * 16 + "-01"):
+        assert trace.parse_traceparent(bad) is None
+
+
+# -------------------------------------------------------------- spans
+
+def test_span_nesting_and_ring_record():
+    with trace.TRACER.activate(round_no=5, chain=b"seed") as tid:
+        assert trace.current_trace_id() == tid
+        assert trace.current_round() == 5
+        with trace.TRACER.span("outer") as outer:
+            with trace.TRACER.span("inner", detail=1) as inner:
+                assert inner.parent_id == outer.span_id
+    assert trace.current_trace_id() is None
+    rounds = trace.TRACER.rounds(4)
+    assert len(rounds) == 1
+    rec = rounds[0]
+    assert rec["round"] == 5 and rec["trace_id"] == tid
+    by_name = {s["name"]: s for s in rec["spans"]}
+    assert by_name["inner"]["parent_id"] == by_name["outer"]["span_id"]
+    assert by_name["inner"]["attrs"] == {"detail": 1}
+    assert all(s["duration_ms"] >= 0.0 for s in rec["spans"])
+
+
+def test_span_without_context_hits_histogram_not_ring():
+    before = _stage_count("orphan_stage")
+    with trace.TRACER.span("orphan_stage"):
+        pass
+    assert _stage_count("orphan_stage") == before + 1
+    assert trace.TRACER.rounds(8) == []
+
+
+def test_ring_bounds_rounds_and_spans():
+    t = trace.Tracer(max_rounds=3, max_spans=2)
+    for r in range(1, 6):
+        with t.activate(round_no=r, chain=b"x"):
+            for _ in range(4):  # 2 over the per-round span cap
+                with t.span("s"):
+                    pass
+    recs = t.rounds(10)
+    assert [rec["round"] for rec in recs] == [5, 4, 3]  # oldest evicted
+    for rec in recs:
+        assert len(rec["spans"]) == 2 and rec["dropped"] == 2
+
+
+def test_retain_false_feeds_histograms_not_new_ring_entries():
+    t = trace.Tracer(max_rounds=4)
+    # a live round timeline exists...
+    with t.activate(round_no=1, chain=b"x"):
+        with t.span("store"):
+            pass
+    # ...then a historical catch-up sweep (retain=False) flies past:
+    # histograms move, the live entry survives, no new entries appear
+    before = _stage_count("sync_verify")
+    for r in range(100, 120):
+        with t.activate(round_no=r, chain=b"x", retain=False):
+            with t.span("sync_verify"):
+                pass
+    assert _stage_count("sync_verify") == before + 20
+    assert [rec["round"] for rec in t.rounds(10)] == [1]
+    # retain=False still APPENDS to an existing live entry
+    with t.activate(round_no=1, chain=b"x", retain=False):
+        with t.span("gossip_validate"):
+            pass
+    assert len(t.rounds(1)[0]["spans"]) == 2
+
+
+def test_span_marks_error_on_exception():
+    ok_before = _stage_count("recover")
+    err_before = _stage_count("recover_error")
+    with trace.TRACER.activate(round_no=6, chain=b"seed"):
+        with pytest.raises(RuntimeError):
+            with trace.TRACER.span("recover"):
+                raise RuntimeError("wedged dispatch")
+    (sp,) = trace.TRACER.rounds(1)[0]["spans"]
+    assert sp["attrs"]["error"] is True
+    assert sp["duration_ms"] is not None
+    # a wedged dispatch's duration must not masquerade as real recover
+    # latency: failed stages land under stage="recover_error"
+    assert _stage_count("recover") == ok_before
+    assert _stage_count("recover_error") == err_before + 1
+    # ...but a semantic rejection (ValueError: below-threshold round)
+    # is an instant raise, not a wedged stage — it lands under
+    # "recover_invalid" so *_error alerts don't page on degraded rounds
+    inv_before = _stage_count("recover_invalid")
+    with trace.TRACER.activate(round_no=7, chain=b"seed"):
+        with pytest.raises(ValueError):
+            with trace.TRACER.span("recover"):
+                raise ValueError("not enough valid partials: 1 < 2")
+    assert _stage_count("recover_invalid") == inv_before + 1
+    assert _stage_count("recover_error") == err_before + 1
+    # task cancellation (daemon stop mid-stage) is routine, not failure
+    can_before = _stage_count("breather_cancelled")
+    with trace.TRACER.activate(round_no=8, chain=b"seed"):
+        with pytest.raises(asyncio.CancelledError):
+            with trace.TRACER.span("breather"):
+                raise asyncio.CancelledError()
+    assert _stage_count("breather_cancelled") == can_before + 1
+    assert _stage_count("breather_error") == 0.0
+
+
+def test_adopt_traceparent_stitches_remote_spans():
+    tid = trace.round_trace_id(9, b"seed")
+    hdr = trace.make_traceparent(tid, "11" * 8)
+    with trace.TRACER.activate_traceparent(hdr):
+        with trace.TRACER.span("remote_leg") as sp:
+            assert sp.trace_id == tid
+            assert sp.parent_id == "11" * 8
+    # malformed ingress is a no-op passthrough
+    with trace.TRACER.activate_traceparent("not-a-traceparent"):
+        assert trace.current_trace_id() is None
+
+
+# ------------------------------------------- cross-node propagation
+
+class _RecordingService(ProtocolService):
+    def __init__(self):
+        self.seen: list[str | None] = []
+
+    async def process_partial_beacon(self, from_addr, packet):
+        self.seen.append(trace.current_trace_id())
+
+
+@pytest.mark.asyncio
+async def test_trace_context_propagates_over_local_network():
+    net = LocalNetwork()
+    svc = _RecordingService()
+    net.register("b.test:1", svc)
+    client = net.client_for("a.test:1")
+
+    class _Peer:
+        def address(self):
+            return "b.test:1"
+
+    with trace.TRACER.activate(round_no=4, chain=b"seed") as tid:
+        await client.partial_beacon(_Peer(), None)
+        # tasks spawned inside the context copy it (the broadcast path)
+        task = asyncio.ensure_future(client.partial_beacon(_Peer(), None))
+    await task
+    assert svc.seen == [tid, tid]
+
+
+def test_grpc_metadata_helpers_roundtrip():
+    assert trace.outbound_metadata() is None  # no active context
+    with trace.TRACER.activate(round_no=2, chain=b"seed") as tid:
+        md = trace.outbound_metadata()
+    assert md is not None
+
+    class _Ctx:
+        def invocation_metadata(self):
+            return md
+
+    class _Raising:
+        def invocation_metadata(self):
+            raise RuntimeError("broken call context")
+
+    parsed = trace.parse_traceparent(trace.traceparent_from_context(_Ctx()))
+    assert parsed is not None and parsed[0] == tid
+    # untrusted ingress must never raise out of the helper
+    assert trace.traceparent_from_context(_Raising()) is None
+    assert trace.traceparent_from(object()) is None
+
+
+# ------------------------------------------------- log correlation
+
+def test_kv_log_lines_carry_round_correlation(caplog):
+    logger = KVLogger("trace-corr-test")
+    with caplog.at_level(logging.INFO, logger="trace-corr-test"):
+        with trace.TRACER.activate(round_no=11, chain=b"seed") as tid:
+            logger.info("aggregator", "stored")
+        logger.info("aggregator", "outside")
+    inside, outside = caplog.messages
+    assert f"trace={tid}" in inside and "round=11" in inside
+    assert "trace=" not in outside
+
+
+def test_default_logger_accepts_aliases_and_bad_levels():
+    # "warn"/"warning"/"error" are valid; junk falls back to info
+    # instead of raising KeyError at daemon startup
+    for lvl, expect in (("warn", logging.WARNING),
+                       ("Warning", logging.WARNING),
+                       ("ERROR", logging.ERROR),
+                       ("debug", logging.DEBUG),
+                       ("bogus", logging.INFO)):
+        lg = default_logger("lvl-test", level=lvl)
+        assert lg._log.level == expect

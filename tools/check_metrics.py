@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Static metrics lint: every metric declared in drand_tpu/metrics must be
+referenced at least once outside its declaration module (no dead
+catalogue entries — the `engine_device_batches` regression, ISSUE 1),
+and metric names must be unique across the four registries (a duplicate
+name silently splits one logical series across registries).
+
+Run standalone (exit 1 on problems) or from the tier-1 suite
+(tests/test_metrics.py::test_metrics_lint) so regressions fail fast.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+METRICS_FILE = REPO / "drand_tpu" / "metrics" / "__init__.py"
+_METRIC_TYPES = {"Counter", "Gauge", "Histogram", "Summary", "Info"}
+
+
+def declared_metrics() -> dict[str, str]:
+    """python identifier -> prometheus metric name, parsed from the
+    module-level assignments in drand_tpu/metrics/__init__.py."""
+    tree = ast.parse(METRICS_FILE.read_text())
+    out: dict[str, str] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        call = node.value
+        if not (isinstance(target, ast.Name) and isinstance(call, ast.Call)):
+            continue
+        fn = call.func
+        fn_name = fn.id if isinstance(fn, ast.Name) else getattr(fn, "attr", "")
+        if fn_name not in _METRIC_TYPES or not call.args:
+            continue
+        first = call.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            out[target.id] = first.value
+    return out
+
+
+def _corpus() -> str:
+    """Every python source that may legitimately reference a metric,
+    minus the declaration module itself."""
+    parts = []
+    for base in ("drand_tpu", "tests", "tools", "scripts"):
+        root = REPO / base
+        if not root.is_dir():
+            continue
+        for path in sorted(root.rglob("*.py")):
+            if path == METRICS_FILE:
+                continue
+            parts.append(path.read_text())
+    bench = REPO / "bench.py"
+    if bench.is_file():
+        parts.append(bench.read_text())
+    return "\n".join(parts)
+
+
+def run_lint() -> list[str]:
+    """-> list of problems (empty when clean)."""
+    problems: list[str] = []
+    decls = declared_metrics()
+    if not decls:
+        return ["no metric declarations found (parser broken?)"]
+    seen: dict[str, str] = {}
+    for py_name, metric_name in decls.items():
+        if metric_name in seen:
+            problems.append(
+                f"duplicate metric name {metric_name!r}: declared as both "
+                f"{seen[metric_name]} and {py_name}")
+        seen[metric_name] = py_name
+    corpus = _corpus()
+    for py_name, metric_name in sorted(decls.items()):
+        if not re.search(rf"\b{re.escape(py_name)}\b", corpus):
+            problems.append(
+                f"dead metric: {py_name} ({metric_name!r}) is declared but "
+                f"never referenced outside drand_tpu/metrics")
+    return problems
+
+
+def main() -> int:
+    problems = run_lint()
+    for p in problems:
+        print(f"check_metrics: {p}", file=sys.stderr)
+    if not problems:
+        print(f"check_metrics: OK ({len(declared_metrics())} metrics, "
+              f"all referenced, names unique)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
