@@ -261,6 +261,10 @@ def bench_recover(trials, t=67, n=100, k_rounds=2):
     assert all(oks), "partial verification failed"
     assert sig and eng.verify_sigs(pubkey, [(msg, sig)]) == [True]
     fused = eng.agg_fused_active(len(partials), t)
+    # which aggregate path the round takes: the RLC combine (2 Miller
+    # pairs for all partials + 2 for the recovered check) or the classic
+    # fused per-item graph
+    rlc = eng.agg_rlc_active(len(partials))
 
     def timed():
         t0 = time.perf_counter()
@@ -274,7 +278,7 @@ def bench_recover(trials, t=67, n=100, k_rounds=2):
     return {"metric": "recover_67_of_100_seconds_per_round",
             "value": round(per_round, 3), "unit": "s/round",
             "rounds_per_sec": round(1 / per_round, 2), "fused": fused,
-            "vs_baseline": None}
+            "rlc": rlc, "vs_baseline": None}
 
 
 def bench_deal_verify(trials, n=128):
@@ -373,6 +377,52 @@ def bench_e2e(trials=1, n=5, t=3, rounds=4):
     return {"metric": "e2e_3of5_100rounds_seconds", "value": round(per100, 2),
             "unit": "s", "rounds_measured": rounds,
             "rounds_per_sec": round(rounds / dt, 2), "vs_baseline": None}
+
+
+def bench_verify_rlc(trials):
+    """Host RLC batch verification vs the per-item loop over a 64-beacon
+    span (crypto/batch_verify.py). Pure host crypto — runs and reports
+    even when the TPU tunnel is down, so the BENCH_*.json trajectory
+    captures the pairing-count win unconditionally. Hash-to-curve is
+    prewarmed (the per-round memo makes it identical, amortized work on
+    both paths; this metric isolates the verification strategy)."""
+    from drand_tpu.chain import beacon as chain_beacon
+    from drand_tpu.chain.beacon import Beacon, message
+    from drand_tpu.crypto import batch_verify, bls
+    from drand_tpu.crypto import pairing as hpairing
+
+    span = 64
+    sk, pub = bls.keygen(seed=b"bench-rlc")
+    prev, beacons = b"\x42" * 32, []
+    for rnd in range(1, span + 1):
+        sig = bls.sign(sk, message(rnd, prev))  # warms the h2c memo too
+        beacons.append(Beacon(round=rnd, previous_sig=prev, signature=sig))
+        prev = sig
+
+    def timed_item():
+        t0 = time.perf_counter()
+        for b in beacons:
+            if not chain_beacon.verify_beacon(pub, b):
+                raise RuntimeError("per-item verification failed")
+        return time.perf_counter() - t0
+
+    def timed_rlc():
+        t0 = time.perf_counter()
+        if not batch_verify.verify_beacons_rlc(pub, beacons).all():
+            raise RuntimeError("RLC verification failed")
+        return time.perf_counter() - t0
+
+    trials = min(trials, 2)
+    c0 = hpairing.N_PRODUCT_CHECKS
+    dt_rlc = best_of(trials, timed_rlc)
+    checks_per_pass = (hpairing.N_PRODUCT_CHECKS - c0) // trials
+    dt_item = best_of(trials, timed_item)
+    return {"metric": "verify_rlc_speedup",
+            "value": round(dt_item / dt_rlc, 2), "unit": "x",
+            "span": span, "per_item_seconds": round(dt_item, 3),
+            "rlc_seconds": round(dt_rlc, 3),
+            "product_checks_per_span": checks_per_pass,
+            "vs_baseline": None}
 
 
 def bench_replay_measured(budget_left, catchup_result=None):
@@ -515,7 +565,8 @@ def main() -> None:
     budget = float(os.environ.get("BENCH_BUDGET_SECONDS", "600"))
     t_start = time.perf_counter()
     which = os.environ.get(
-        "BENCH_CONFIGS", "e2e,catchup,recover,deal,replay,headline").split(",")
+        "BENCH_CONFIGS",
+        "rlc,e2e,catchup,recover,deal,replay,headline").split(",")
 
     # --- outage-proofing (round-3 lesson: the official record must never
     # be an unparseable traceback). Two layers:
@@ -571,6 +622,20 @@ def main() -> None:
 
     threading.Thread(target=_global_watchdog, daemon=True,
                      name="bench-watchdog").start()
+
+    # the host-only RLC config runs FIRST, before backend init: its
+    # record must land even when the tunnel is down (that is the point
+    # of having a host-measured aux metric in the trajectory)
+    if "rlc" in which:
+        log("== host RLC batch-verify speedup (64-beacon span) ==")
+        try:
+            emit(bench_verify_rlc(trials))
+        except Exception as e:  # noqa: BLE001 — best-effort aux config
+            import traceback
+
+            log(traceback.format_exc())
+            diag("aux_config_failed", config="rlc",
+                 error=f"{type(e).__name__}: {e}")
 
     from drand_tpu.utils.backend import BackendUnavailable, init_backend
 

@@ -16,6 +16,14 @@ Modes (env ``DRAND_TPU_ENGINE`` or :func:`configure`):
 The device engine is created lazily (it imports jax and compiles on first
 use) and any engine failure falls back to the host path — the host
 implementation is the semantics oracle.
+
+Host batches of >= max(DRAND_TPU_BATCH_VERIFY, 2) items (default on;
+``DRAND_TPU_BATCH_VERIFY=0`` reverts to the exact per-item loops) run
+the randomized-linear-combination batch verifier
+(crypto/batch_verify.py): one 2-pairing product check per all-valid
+span instead of one per item, recorded under ``path="host_rlc"`` in
+engine_op_seconds so the speedup shows up next to ``host`` and
+``device``.
 """
 
 from __future__ import annotations
@@ -25,7 +33,7 @@ import time as _time
 
 import numpy as np
 
-from . import tbls
+from . import batch_verify, tbls
 from .curves import PointG1
 from .hash_to_curve import DEFAULT_DST_G2
 from .poly import PubPoly
@@ -34,6 +42,43 @@ _MODE = os.environ.get("DRAND_TPU_ENGINE", "auto")
 _MIN_BATCH = int(os.environ.get("DRAND_TPU_MIN_BATCH", "8"))
 _ENGINE = None
 _FALLBACK_LOGGED = False
+
+
+_RLC_KNOB_WARNED = False
+
+
+def _rlc_threshold() -> int | None:
+    """Host-path RLC batch-verification policy (DRAND_TPU_BATCH_VERIFY):
+    ``0``/``off``/``false`` disables it — the host paths then run the
+    exact per-item loops (the escape hatch); on (the default) routes
+    host batches of at least max(k, 2) items through
+    crypto/batch_verify's one-product-check path, where k is the knob's
+    integer value. An UNRECOGNIZED value disables the fast path too
+    (warn once): the knob exists to turn the new code OFF, so a
+    misspelled disable attempt must never silently leave it on."""
+    global _RLC_KNOB_WARNED
+    raw = os.environ.get("DRAND_TPU_BATCH_VERIFY", "1").strip().lower()
+    if raw in ("1", "on", "true", "yes", ""):
+        return 2
+    if raw in ("0", "off", "false", "no"):
+        return None
+    try:
+        v = int(raw)
+    except ValueError:
+        if not _RLC_KNOB_WARNED:
+            _RLC_KNOB_WARNED = True
+            from ..utils.logging import default_logger
+
+            default_logger("batch").warn(
+                "rlc", "bad_knob_value", value=raw,
+                effect="batch verification disabled (per-item path)")
+        return None
+    return None if v <= 0 else max(v, 2)
+
+
+def _use_rlc(n_items: int) -> bool:
+    thr = _rlc_threshold()
+    return thr is not None and n_items >= thr
 
 
 def _note_fallback(op: str, err: Exception) -> None:
@@ -49,6 +94,14 @@ def _note_fallback(op: str, err: Exception) -> None:
 
         default_logger("batch").warn(
             "engine", "device_fallback", op=op, err=repr(err))
+
+
+def _note_device_ok() -> None:
+    """A device dispatch succeeded: re-arm the fallback warning so a
+    backend that recovers and then breaks AGAIN warns again (the flag
+    used to stay set for the life of the process)."""
+    global _FALLBACK_LOGGED
+    _FALLBACK_LOGGED = False
 
 
 def _note_dispatch(op: str) -> None:
@@ -173,11 +226,16 @@ def verify_beacons(pubkey: PointG1, beacons,
         try:
             _note_dispatch("verify_beacons")
             with _timed("verify_beacons", "device", len(beacons)):
-                return engine().verify_beacons(pubkey, beacons, dst)
+                out = engine().verify_beacons(pubkey, beacons, dst)
+            _note_device_ok()
+            return out
         except Exception as e:  # noqa: BLE001 — host path is the oracle
             if _MODE == "device":
                 raise
             _note_fallback("verify_beacons", e)
+    if _use_rlc(len(beacons)):
+        with _timed("verify_beacons", "host_rlc", len(beacons)):
+            return batch_verify.verify_beacons_rlc(pubkey, beacons, dst)
     with _timed("verify_beacons", "host", len(beacons)):
         out = np.zeros(len(beacons), dtype=bool)
         for i, b in enumerate(beacons):
@@ -196,11 +254,17 @@ def verify_partials(pub_poly: PubPoly, msg: bytes, partials,
         try:
             _note_dispatch("verify_partials")
             with _timed("verify_partials", "device", len(partials)):
-                return engine().verify_partials(pub_poly, msg, partials, dst)
+                out = engine().verify_partials(pub_poly, msg, partials, dst)
+            _note_device_ok()
+            return out
         except Exception as e:  # noqa: BLE001
             if _MODE == "device":
                 raise
             _note_fallback("verify_partials", e)
+    if _use_rlc(len(partials)):
+        with _timed("verify_partials", "host_rlc", len(partials)):
+            return batch_verify.verify_partials_rlc(pub_poly, msg, partials,
+                                                    dst)
     with _timed("verify_partials", "host", len(partials)):
         return [tbls.verify_partial(pub_poly, msg, p, dst) for p in partials]
 
@@ -213,11 +277,16 @@ def verify_recovered_many(pubkey: PointG1, pairs,
         try:
             _note_dispatch("verify_recovered_many")
             with _timed("verify_recovered_many", "device", len(pairs)):
-                return engine().verify_sigs(pubkey, pairs, dst)
+                out = engine().verify_sigs(pubkey, pairs, dst)
+            _note_device_ok()
+            return out
         except Exception as e:  # noqa: BLE001
             if _MODE == "device":
                 raise
             _note_fallback("verify_recovered_many", e)
+    if _use_rlc(len(pairs)):
+        with _timed("verify_recovered_many", "host_rlc", len(pairs)):
+            return batch_verify.verify_sigs_rlc(pubkey, pairs, dst)
     with _timed("verify_recovered_many", "host", len(pairs)):
         return [tbls.verify_recovered(pubkey, m, s, dst) for m, s in pairs]
 
@@ -230,7 +299,9 @@ def recover(pub_poly: PubPoly, msg: bytes, partials, t: int, n: int,
         try:
             _note_dispatch("recover")
             with _timed("recover", "device", t):
-                return engine().recover(pub_poly, msg, partials, t, n, dst)
+                out = engine().recover(pub_poly, msg, partials, t, n, dst)
+            _note_device_ok()
+            return out
         except ValueError:
             raise  # semantic error (not enough partials): no fallback
         except Exception as e:  # noqa: BLE001
@@ -264,8 +335,10 @@ def aggregate_round(pub_poly: PubPoly, msg: bytes, partials, t: int, n: int,
             with TRACER.span("recover", path="device", fused=True,
                              partials=len(partials)), \
                     _timed("aggregate_round", "device", len(partials)):
-                return engine().aggregate_round(pub_poly, msg, partials,
-                                                t, n, dst)
+                out = engine().aggregate_round(pub_poly, msg, partials,
+                                               t, n, dst)
+            _note_device_ok()
+            return out
         except ValueError:
             raise  # semantic error: no fallback
         except Exception as e:  # noqa: BLE001
@@ -277,8 +350,12 @@ def aggregate_round(pub_poly: PubPoly, msg: bytes, partials, t: int, n: int,
             oks = [len(p) == tbls.PARTIAL_SIG_SIZE for p in partials]
         else:
             with TRACER.span("verify", what="partials", n=len(partials)):
-                oks = [tbls.verify_partial(pub_poly, msg, p, dst)
-                       for p in partials]
+                if _use_rlc(len(partials)):
+                    oks = batch_verify.verify_partials_rlc(
+                        pub_poly, msg, partials, dst)
+                else:
+                    oks = [tbls.verify_partial(pub_poly, msg, p, dst)
+                           for p in partials]
         good = [p for p, ok in zip(partials, oks) if ok]
         if len(good) < t:
             raise ValueError(f"not enough valid partials: {len(good)} < {t}")
@@ -299,7 +376,9 @@ def eval_commits(polys: list[PubPoly], index: int) -> list[PointG1]:
         try:
             _note_dispatch("eval_commits")
             with _timed("eval_commits", "device", len(polys)):
-                return engine().eval_commits(polys, index)
+                out = engine().eval_commits(polys, index)
+            _note_device_ok()
+            return out
         except Exception as e:  # noqa: BLE001
             if _MODE == "device":
                 raise

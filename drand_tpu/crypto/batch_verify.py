@@ -1,0 +1,255 @@
+"""Randomized batch verification (small-exponent RLC) — host path.
+
+Collapses N independent BLS pairing checks into ONE 2-pairing product
+check plus two size-N multi-scalar multiplications, the standard
+random-linear-combination batch verifier from the BLS aggregate-verify
+literature (kyber's ``bls.BatchVerify`` shape). For a span of checks
+that all share the verification template ``e(-g1, sig_i) * e(pk_i,
+H(m_i)) == 1``:
+
+    sample independent random nonzero 128-bit scalars c_i, check
+        e(-g1, sum c_i*sig_i) * e(sum-or-fixed side combined) == 1
+
+Two span shapes appear in the beacon protocol and both are bilinear in
+exactly one argument, so each collapses to 2 pairings + 2 MSMs:
+
+- one public key, many messages (chain catch-up / sync / recovered-sig
+  re-verification):  e(-g1, S) * e(pub, M) == 1 with
+  S = sum c_i*sig_i (G2 MSM) and M = sum c_i*H(m_i) (G2 MSM);
+- one message, many public keys (a round's partials): e(-g1, S) *
+  e(K, H(msg)) == 1 with K = sum c_i*pk_i (G1 MSM).
+
+Soundness (why this is safe):
+
+- Every signature is individually decoded, subgroup-checked (via the
+  psi-endomorphism fast check, same acceptance set as the generic
+  order-r multiplication) and rejected if it is the point at infinity
+  BEFORE entering the combination. Without per-item subgroup checks an
+  adversary could plant cofactor-order components that cancel with
+  probability 1/ord(component) — the classic small-subgroup attack on
+  batch verification.
+- With all points in the r-order subgroups, a batch containing at least
+  one invalid signature passes only if the random vector (c_1..c_N)
+  lands in a proper subspace of Fr^N fixed before the scalars are
+  drawn: probability <= 2^-128 per verification (scalars are uniform
+  nonzero 128-bit values, and r > 2^254).
+- The scalars come from ``secrets`` (the OS CSPRNG) and MUST stay
+  unpredictable: if an adversary knows c_i before choosing its inputs
+  it can submit sig_1+D and sig_2-(c_1/c_2)*D, which cancel in the
+  combination while both items are individually invalid. Never derive
+  the scalars from the batch content.
+- A zero scalar would delete its item from the check entirely, so
+  scalars are drawn nonzero.
+
+On batch failure the span bisects (each half re-checked with FRESH
+scalars) down to single items, which are decided by the exact per-item
+oracle (tbls.verify_partial / tbls.verify_recovered) — the returned
+bool array is therefore bit-identical to the per-item path on every
+input, and an all-valid span (the overwhelmingly common case) costs
+exactly one product check.
+
+Dispatch policy (which path runs when) lives in crypto/batch.py; the
+device-graph version of the same combination lives in ops/engine.py.
+"""
+
+from __future__ import annotations
+
+import secrets
+
+import numpy as np
+
+from . import endo, tbls
+from .curves import PointG1, PointG2, _JacobianPoint
+from .hash_to_curve import DEFAULT_DST_G2, hash_to_g2
+from .pairing import pairing_check
+from .poly import PubPoly
+
+RLC_SCALAR_BITS = 128
+
+
+def rlc_scalars(n: int) -> list[int]:
+    """n independent uniform nonzero 128-bit scalars from the OS CSPRNG.
+
+    Unpredictability is load-bearing (see module docstring): predictable
+    scalars admit cancelling forgeries. Nonzero because a zero scalar
+    removes its item from the combined check.
+    """
+    out = []
+    for _ in range(n):
+        c = 0
+        while c == 0:
+            c = secrets.randbits(RLC_SCALAR_BITS)
+        out.append(c)
+    return out
+
+
+def decode_sig(sig_bytes: bytes) -> PointG2 | None:
+    """Wire signature -> point, or None if it must be rejected per-item:
+    malformed encoding, point at infinity, or outside the r-order
+    subgroup (psi-endomorphism check — same acceptance set as
+    ``PointG2.from_bytes(subgroup_check=True)``, ~3x cheaper, and the
+    prefilter is the per-item cost of the RLC path)."""
+    try:
+        pt = PointG2.from_bytes(sig_bytes, subgroup_check=False)
+    except ValueError:
+        return None
+    if pt.is_infinity():
+        return None
+    if not endo.subgroup_check_fast(pt):
+        return None
+    return pt
+
+
+# ---------------------------------------------------------------------------
+# Host MSM: interleaved 4-bit windows with one shared doubling chain —
+# ~46 point-adds per item + 124 shared doublings for 128-bit scalars,
+# vs ~192 ops per item for independent double-and-add. This is the term
+# that must stay well under a Miller loop for the >=5x span speedup.
+# ---------------------------------------------------------------------------
+
+_MSM_WINDOW = 4
+
+
+def msm(points: list[_JacobianPoint], scalars: list[int]):
+    """sum_i scalars_i * points_i for nonnegative scalars < 2^128."""
+    if not points:
+        raise ValueError("empty MSM")
+    cls = type(points[0])
+    tables = []
+    for p in points:
+        tbl = [None] * (1 << _MSM_WINDOW)
+        tbl[1] = p
+        for k in range(2, 1 << _MSM_WINDOW):
+            tbl[k] = tbl[k - 1] + p
+        tables.append(tbl)
+    acc = cls.infinity()
+    nwin = (RLC_SCALAR_BITS + _MSM_WINDOW - 1) // _MSM_WINDOW
+    for win in range(nwin - 1, -1, -1):
+        if win != nwin - 1:
+            for _ in range(_MSM_WINDOW):
+                acc = acc.double()
+        shift = win * _MSM_WINDOW
+        for tbl, c in zip(tables, scalars):
+            d = (c >> shift) & ((1 << _MSM_WINDOW) - 1)
+            if d:
+                acc = acc + tbl[d]
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# The recursive span check
+# ---------------------------------------------------------------------------
+
+def _rlc_pass(items, fixed_g1: PointG1 | None, msg_pt: PointG2 | None) -> bool:
+    """One product check over ``items`` = [(pos, sig_pt, other)] where
+    ``other`` is H(m_i) (fixed_g1 set: one-key-many-messages shape) or
+    pk_i (msg_pt set: one-message-many-keys shape)."""
+    cs = rlc_scalars(len(items))
+    s_comb = msm([sig for _, sig, _ in items], cs)
+    if fixed_g1 is not None:
+        g1_side = fixed_g1
+        g2_side = msm([other for _, _, other in items], cs)
+    else:
+        g1_side = msm([other for _, _, other in items], cs)
+        g2_side = msg_pt
+    if s_comb.is_infinity() or g1_side.is_infinity() or g2_side.is_infinity():
+        # a vacuously-degenerate combination must never decide a span —
+        # report failure so the caller bisects down to the per-item oracle
+        # (for honest inputs this has ~2^-128 probability)
+        return False
+    return pairing_check([(-PointG1.generator(), s_comb),
+                          (g1_side, g2_side)])
+
+
+def _resolve(items, out: list[bool], leaf, fixed_g1, msg_pt) -> None:
+    """Mark out[pos] for every item: one RLC check per all-valid span,
+    bisection (fresh scalars per sub-span) otherwise, per-item oracle at
+    the leaves."""
+    if not items:
+        return
+    if len(items) == 1:
+        pos = items[0][0]
+        out[pos] = leaf(pos)
+        return
+    if _rlc_pass(items, fixed_g1, msg_pt):
+        for pos, _, _ in items:
+            out[pos] = True
+        return
+    mid = len(items) // 2
+    _resolve(items[:mid], out, leaf, fixed_g1, msg_pt)
+    _resolve(items[mid:], out, leaf, fixed_g1, msg_pt)
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+def verify_sigs_rlc(pubkey: PointG1, checks,
+                    dst: bytes = DEFAULT_DST_G2) -> list[bool]:
+    """Batch of (msg_bytes, sig_bytes) full-signature checks against ONE
+    public key — RLC over distinct messages. Bool list aligned with
+    ``checks``, bit-identical to per-item tbls.verify_recovered."""
+    out = [False] * len(checks)
+    if pubkey.is_infinity():
+        return out
+    items = []
+    for i, (m, s) in enumerate(checks):
+        pt = decode_sig(s)
+        if pt is None:
+            continue  # per-item reject; never enters the combination
+        items.append((i, pt, hash_to_g2(m, dst)))
+
+    def leaf(pos: int) -> bool:
+        m, s = checks[pos]
+        return tbls.verify_recovered(pubkey, m, s, dst)
+
+    _resolve(items, out, leaf, pubkey, None)
+    return out
+
+
+def verify_beacons_rlc(pubkey: PointG1, beacons,
+                       dst: bytes = DEFAULT_DST_G2) -> np.ndarray:
+    """Dual (V1 + V2-when-present) beacon verification over a span as one
+    flattened RLC check — same bool-per-beacon contract as the per-item
+    loop in crypto/batch.verify_beacons."""
+    from ..chain import beacon as chain_beacon
+
+    checks: list[tuple[bytes, bytes]] = []
+    spans: list[tuple[int, int]] = []
+    for b in beacons:
+        start = len(checks)
+        checks.append((chain_beacon.message(b.round, b.previous_sig),
+                       b.signature))
+        if b.is_v2():
+            checks.append((chain_beacon.message_v2(b.round), b.signature_v2))
+        spans.append((start, len(checks) - start))
+    flat = verify_sigs_rlc(pubkey, checks, dst)
+    return np.array([all(flat[s:s + c]) for s, c in spans], dtype=bool)
+
+
+def verify_partials_rlc(pub_poly: PubPoly, msg: bytes, partials,
+                        dst: bytes = DEFAULT_DST_G2) -> list[bool]:
+    """A round's partial signatures — one message, per-index public key
+    shares — as one RLC check. Bool list aligned with ``partials``,
+    bit-identical to per-item tbls.verify_partial (duplicate share
+    indices are independent items, exactly as the per-item loop treats
+    them)."""
+    out = [False] * len(partials)
+    msg_pt = hash_to_g2(msg, dst)
+    items = []
+    for i, p in enumerate(partials):
+        if len(p) != tbls.PARTIAL_SIG_SIZE:
+            continue
+        pt = decode_sig(p[tbls.INDEX_BYTES:])
+        if pt is None:
+            continue
+        pk = pub_poly.eval(tbls.index_of(p)).value
+        if pk.is_infinity():
+            continue  # oracle: e(-g1, sig) alone is 1 only for sig == O
+        items.append((i, pt, pk))
+
+    def leaf(pos: int) -> bool:
+        return tbls.verify_partial(pub_poly, msg, partials[pos], dst)
+
+    _resolve(items, out, leaf, None, msg_pt)
+    return out

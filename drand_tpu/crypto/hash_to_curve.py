@@ -21,7 +21,8 @@ on E2, so the map cannot be silently wrong.
 from __future__ import annotations
 
 import hashlib
-from functools import lru_cache
+import threading
+from collections import OrderedDict
 
 from .fields import P, Fp2, XI, fp_inv
 from .curves import PointG2
@@ -446,15 +447,64 @@ def map_to_curve_g2(u: Fp2) -> PointG2:
     return PointG2.from_affine(X, Y)
 
 
-@lru_cache(maxsize=1024)
+# Keyed (msg, dst) memo for hash_to_g2. In one beacon round every node
+# hashes the same two messages (V1 and V2) once per sign and once per
+# incoming partial — sign_partial, t verify_partials, recover and
+# verify_recovered of the same round all reuse one computed point. A
+# hand-rolled LRU (not functools.lru_cache) so hit/miss counts are
+# observable: they feed the hash_to_g2_cache_requests metric and tell
+# an operator whether the per-round memo actually amortizes.
+_H2C_MAXSIZE = 1024
+_H2C_CACHE: "OrderedDict[tuple[bytes, bytes], PointG2]" = OrderedDict()
+# functools.lru_cache is internally locked; this LRU must be too (a
+# threaded embedder's concurrent hit + evicting miss would otherwise
+# race move_to_end against popitem). The lock only covers dict ops —
+# the ~30 ms hash-to-curve compute happens outside it.
+_H2C_LOCK = threading.Lock()
+_h2c_hits = 0
+_h2c_misses = 0
+
+
+def h2c_cache_info() -> dict:
+    """Hit/miss/size counters of the hash_to_g2 memo (process lifetime)."""
+    return {"hits": _h2c_hits, "misses": _h2c_misses,
+            "size": len(_H2C_CACHE), "maxsize": _H2C_MAXSIZE}
+
+
+def h2c_cache_clear() -> None:
+    with _H2C_LOCK:
+        _H2C_CACHE.clear()
+
+
 def hash_to_g2(msg: bytes, dst: bytes = DEFAULT_DST_G2) -> PointG2:
     """Full hash_to_curve: uniform, deterministic map into the r-order
     subgroup of G2. This is H(m) in every signature equation.
 
-    Memoized: in one beacon round every node hashes the same two messages
-    (V1 and V2) once per sign and once per incoming partial — the protocol
-    hot loop reuses the cached point.
+    Memoized per (msg, dst) — see the LRU note above; hit/miss counters
+    are exported as hash_to_g2_cache_requests{result}.
     """
+    global _h2c_hits, _h2c_misses
+    # metrics import is lazy (crypto/batch.py idiom) and the label
+    # values are literal at the call sites so tools/check_metrics.py
+    # can lint them against the catalogue
+    from .. import metrics
+
+    key = (msg, dst)
+    with _H2C_LOCK:
+        got = _H2C_CACHE.get(key)
+        if got is not None:
+            _H2C_CACHE.move_to_end(key)
+            _h2c_hits += 1
+    if got is not None:
+        metrics.H2C_CACHE_REQUESTS.labels(result="hit").inc()
+        return got
     u0, u1 = hash_to_field_fp2(msg, dst, 2)
     q = map_to_curve_g2(u0) + map_to_curve_g2(u1)
-    return q.mul(_H_CLEAR)
+    pt = q.mul(_H_CLEAR)
+    with _H2C_LOCK:
+        _H2C_CACHE[key] = pt
+        if len(_H2C_CACHE) > _H2C_MAXSIZE:
+            _H2C_CACHE.popitem(last=False)
+        _h2c_misses += 1
+    metrics.H2C_CACHE_REQUESTS.labels(result="miss").inc()
+    return pt

@@ -24,6 +24,13 @@ from __future__ import annotations
 from .fields import P, R, X_BLS, XI, Fp2, Fp6, Fp12
 from .curves import PointG1, PointG2
 
+# Lightweight op counters (plain ints — read/reset by tests and bench).
+# The RLC batch verifier's acceptance criterion is "one 2-pairing product
+# check for a whole all-valid span"; these make that claim checkable
+# without monkeypatching the hot path.
+N_PRODUCT_CHECKS = 0   # multi_pairing invocations that ran a Miller loop
+N_MILLER_PAIRS = 0     # total (P, Q) pairs fed through Miller loops
+
 
 # ---------------------------------------------------------------------------
 # Monomials c * w^k  (c in Fp2, 0 <= k < 6) — sparse Fp12 elements used for
@@ -199,6 +206,9 @@ def multi_pairing(pairs: list[tuple[PointG1, PointG2]], canonical: bool = True) 
     live = [(p, q) for (p, q) in pairs if not p.is_infinity() and not q.is_infinity()]
     if not live:
         return Fp12.one()
+    global N_PRODUCT_CHECKS, N_MILLER_PAIRS
+    N_PRODUCT_CHECKS += 1
+    N_MILLER_PAIRS += len(live)
     return final_exponentiation(miller_loop(live), canonical=canonical)
 
 

@@ -30,7 +30,12 @@ Additions beyond the reference (the TPU engine + round tracing):
       fed by the obs tracing spans (obs/trace.py) — partial, collect,
       recover, verify, store, sync_verify, gossip_validate, breather
   engine_op_seconds{op,path,batch}     [private] per-op device-vs-host
-      latency, batch-size-bucketed (crypto/batch.py dispatch wrappers)
+      latency, batch-size-bucketed (crypto/batch.py dispatch wrappers);
+      path="host_rlc" marks the randomized-linear-combination batch
+      verifier (crypto/batch_verify.py — one 2-pairing product check
+      for a whole span instead of one per item)
+  hash_to_g2_cache_requests{result}    [private] hash-to-G2 memo
+      hit/miss counters (crypto/hash_to_curve.py per-round keyed LRU)
 
 Everything is exposed on /metrics (render() gathers all four registries
 — the reference's handler chains its gatherers the same way,
@@ -120,6 +125,11 @@ ENGINE_BATCHES = Counter(
 ENGINE_FALLBACKS = Counter(
     "engine_device_fallbacks", "Device-engine failures that fell back to host",
     registry=REGISTRY)
+H2C_CACHE_REQUESTS = Counter(
+    "hash_to_g2_cache_requests",
+    "hash_to_g2 memo lookups by result (hit|miss) — the per-round "
+    "hash-to-curve LRU in crypto/hash_to_curve.py",
+    ["result"], registry=REGISTRY)
 
 # ---- round tracing (obs/trace.py) -----------------------------------------
 # Stage/op work spans sub-millisecond (host crypto on small groups) to

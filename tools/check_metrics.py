@@ -2,8 +2,11 @@
 """Static metrics lint: every metric declared in drand_tpu/metrics must be
 referenced at least once outside its declaration module (no dead
 catalogue entries — the `engine_device_batches` regression, ISSUE 1),
-and metric names must be unique across the four registries (a duplicate
-name silently splits one logical series across registries).
+metric names must be unique across the four registries (a duplicate
+name silently splits one logical series across registries), and the
+engine_op_seconds ``path`` label values used at the dispatch sites must
+come from the documented set (a typo'd path label would silently fork
+the series operators alert on).
 
 Run standalone (exit 1 on problems) or from the tier-1 suite
 (tests/test_metrics.py::test_metrics_lint) so regressions fail fast.
@@ -19,6 +22,14 @@ import sys
 REPO = pathlib.Path(__file__).resolve().parent.parent
 METRICS_FILE = REPO / "drand_tpu" / "metrics" / "__init__.py"
 _METRIC_TYPES = {"Counter", "Gauge", "Histogram", "Summary", "Info"}
+
+# engine_op_seconds base path labels (crypto/batch.py _timed); the
+# _error/_invalid suffixes are appended dynamically on failure paths.
+KNOWN_ENGINE_PATHS = {"host", "device", "host_rlc"}
+# known label VALUES per labelled counter whose cardinality is a fixed
+# enum (new values need a deliberate catalogue update here + README)
+KNOWN_LABEL_VALUES = {"hash_to_g2_cache_requests": {"result": {"hit",
+                                                               "miss"}}}
 
 
 def declared_metrics() -> dict[str, str]:
@@ -61,6 +72,36 @@ def _corpus() -> str:
     return "\n".join(parts)
 
 
+def engine_path_labels() -> set[str]:
+    """Every literal ``path`` argument handed to crypto/batch.py's
+    ``_timed`` dispatch timer (second positional arg)."""
+    src = (REPO / "drand_tpu" / "crypto" / "batch.py").read_text()
+    out: set[str] = set()
+    for node in ast.walk(ast.parse(src)):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "_timed"
+                and len(node.args) >= 2):
+            continue
+        arg = node.args[1]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            out.add(arg.value)
+        else:
+            out.add("<dynamic>")
+    return out
+
+
+def labels_used(corpus: str, identifier: str) -> dict[str, set[str]]:
+    """Literal ``IDENT.labels(key="value")`` kwargs across the corpus."""
+    out: dict[str, set[str]] = {}
+    pat = rf"\b{re.escape(identifier)}\.labels\(([^)]*)\)"
+    for m in re.finditer(pat, corpus):
+        for k, v in re.findall(r"(\w+)\s*=\s*[\"']([^\"']+)[\"']",
+                               m.group(1)):
+            out.setdefault(k, set()).add(v)
+    return out
+
+
 def run_lint() -> list[str]:
     """-> list of problems (empty when clean)."""
     problems: list[str] = []
@@ -80,6 +121,36 @@ def run_lint() -> list[str]:
             problems.append(
                 f"dead metric: {py_name} ({metric_name!r}) is declared but "
                 f"never referenced outside drand_tpu/metrics")
+    # engine_op_seconds path labels at the dispatch sites must be from
+    # the documented set (suffixes are appended dynamically)
+    for path in sorted(engine_path_labels()):
+        if path not in KNOWN_ENGINE_PATHS:
+            problems.append(
+                f"unknown engine_op_seconds path label {path!r} in "
+                f"crypto/batch.py (known: {sorted(KNOWN_ENGINE_PATHS)})")
+    # fixed-enum label values: literal uses must be in the catalogue
+    name_to_py = {v: k for k, v in decls.items()}
+    for metric_name, expected in KNOWN_LABEL_VALUES.items():
+        py_name = name_to_py.get(metric_name)
+        if py_name is None:
+            problems.append(
+                f"KNOWN_LABEL_VALUES names undeclared metric "
+                f"{metric_name!r}")
+            continue
+        used = labels_used(corpus, py_name)
+        if not used:
+            # a configured metric with zero literal label uses means the
+            # check validates nothing — e.g. values routed through a
+            # wrapper variable; keep call-site values literal instead
+            problems.append(
+                f"{metric_name}: no literal .labels(...) uses found — "
+                f"the KNOWN_LABEL_VALUES lint cannot validate it")
+        for key, values in used.items():
+            bad = values - expected.get(key, set())
+            if bad:
+                problems.append(
+                    f"{metric_name}: unexpected {key} label value(s) "
+                    f"{sorted(bad)} (known: {sorted(expected.get(key, set()))})")
     return problems
 
 
