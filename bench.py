@@ -28,8 +28,10 @@ Environment knobs:
     BENCH_MIN_SECONDS  minimum timed window per trial (default 5.0)
     BENCH_TRIALS       trials per config (default 2; best wins)
     BENCH_CONFIGS      comma list to run: any of
-                       e2e,catchup,recover,deal,replay,headline
-                       (default: all)
+                       msm,rlc,e2e,catchup,recover,deal,replay,headline
+                       (default: all; msm and rlc are host-only and run
+                       FIRST, before backend init, so they report even
+                       with the TPU tunnel down)
     DRAND_TPU_CONV     tree|kara|unroll — limb conv strategy (A/B)
     DRAND_TPU_LAZY     1|0 — lazy Fp2/6/12 reduction (A/B)
     DRAND_TPU_PAIRFOLD 1|0 — paired-line Miller fold (A/B)
@@ -425,6 +427,46 @@ def bench_verify_rlc(trials):
             "vs_baseline": None}
 
 
+def bench_msm_pippenger(trials):
+    """Host MSM strategy A/B on a 64-point G2 span with 128-bit RLC
+    scalars: the ψ-endomorphism-split Pippenger (crypto/batch_verify.msm
+    — what the RLC combine actually runs) vs the original interleaved
+    4-bit-window ladder (msm_window, the reference). Pure host crypto,
+    runs before backend init — the MSM win is reportable with the
+    tunnel down, independent of any driver."""
+    import secrets
+
+    from drand_tpu.crypto import batch_verify
+    from drand_tpu.crypto.curves import PointG2
+
+    span = 64
+    g2 = PointG2.generator()
+    points = [g2.mul(3 + 2 * i) for i in range(span)]
+    scalars = [secrets.randbits(batch_verify.RLC_SCALAR_BITS) | 1
+               for _ in range(span)]
+    expect = batch_verify.msm_window(points, scalars)
+    if batch_verify.msm(points, scalars) != expect:
+        raise RuntimeError("pippenger MSM disagrees with the window MSM")
+
+    def timed(fn):
+        def run():
+            t0 = time.perf_counter()
+            fn(points, scalars)
+            return time.perf_counter() - t0
+        return run
+
+    trials = min(trials, 3)
+    dt_pip = best_of(trials, timed(batch_verify.msm))
+    dt_win = best_of(trials, timed(batch_verify.msm_window))
+    return {"metric": "msm_pippenger_speedup",
+            "value": round(dt_win / dt_pip, 2), "unit": "x",
+            "span": span, "scalar_bits": batch_verify.RLC_SCALAR_BITS,
+            "endo_split_bits": batch_verify._ENDO_Q_BITS,
+            "window_seconds": round(dt_win, 3),
+            "pippenger_seconds": round(dt_pip, 3),
+            "vs_baseline": None}
+
+
 def bench_replay_measured(budget_left, catchup_result=None):
     """1M-round replay, MEASURED (BASELINE config 5; the reference's
     de-facto capability of replaying a real chain —
@@ -566,7 +608,7 @@ def main() -> None:
     t_start = time.perf_counter()
     which = os.environ.get(
         "BENCH_CONFIGS",
-        "rlc,e2e,catchup,recover,deal,replay,headline").split(",")
+        "msm,rlc,e2e,catchup,recover,deal,replay,headline").split(",")
 
     # --- outage-proofing (round-3 lesson: the official record must never
     # be an unparseable traceback). Two layers:
@@ -623,9 +665,19 @@ def main() -> None:
     threading.Thread(target=_global_watchdog, daemon=True,
                      name="bench-watchdog").start()
 
-    # the host-only RLC config runs FIRST, before backend init: its
-    # record must land even when the tunnel is down (that is the point
-    # of having a host-measured aux metric in the trajectory)
+    # the host-only configs run FIRST, before backend init: their
+    # records must land even when the tunnel is down (that is the point
+    # of having host-measured aux metrics in the trajectory)
+    if "msm" in which:
+        log("== host MSM pippenger+endomorphism speedup (64-point G2) ==")
+        try:
+            emit(bench_msm_pippenger(trials))
+        except Exception as e:  # noqa: BLE001 — best-effort aux config
+            import traceback
+
+            log(traceback.format_exc())
+            diag("aux_config_failed", config="msm",
+                 error=f"{type(e).__name__}: {e}")
     if "rlc" in which:
         log("== host RLC batch-verify speedup (64-beacon span) ==")
         try:

@@ -209,7 +209,8 @@ def cmd_follow(args) -> None:
 
         ctl = ControlClient(args.control)
         try:
-            out = await ctl.follow(args.sync_nodes.split(","), args.up_to)
+            out = await ctl.follow(args.sync_nodes.split(","), args.up_to,
+                                   info_hash=args.chain_hash or "")
             print(json.dumps(out, indent=2))
         finally:
             await ctl.close()
@@ -672,6 +673,9 @@ def main(argv=None) -> None:
     f.add_argument("--control", type=int, default=8888)
     f.add_argument("--sync-nodes", required=True)
     f.add_argument("--up-to", type=int, default=0)
+    f.add_argument("--chain-hash", default="",
+                   help="hex chain-info hash to pin (peers serving a "
+                        "different chain are rejected)")
     f.set_defaults(fn=cmd_follow)
 
     st = sub.add_parser("stop")

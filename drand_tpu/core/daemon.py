@@ -109,10 +109,19 @@ class Drand(ProtocolService):
                 raise DrandError(
                     "a setup phase is already in progress (pass force "
                     "to preempt it)")
-            if self._setup_mgr is None:
+            if self._setup_mgr is not None:
+                self._setup_mgr.cancel()
+            elif (self._group_packet is not None
+                  and not self._group_packet.done()):
+                # a FOLLOWER setup holds the slot while awaiting the
+                # leader's group packet — no SetupManager, no DKG
+                # running yet. That phase is preemptable too: cancel the
+                # future so the waiting init unwinds (it releases only
+                # its own token; ours survives).
+                self._group_packet.cancel()
+            else:
                 raise DrandError(
                     "cannot preempt: the DKG phase is already running")
-            self._setup_mgr.cancel()
         token = object()
         self._setup_token = token
         return token
@@ -167,10 +176,14 @@ class Drand(ProtocolService):
         token = self._acquire_setup(force)
         try:
             self._expected_secret = secret
-            self._group_packet = asyncio.get_event_loop().create_future()
+            # bind the future locally: a forced preemptor cancels it and
+            # installs ITS OWN as self._group_packet — re-reading the
+            # attribute here would make a preempted init await (and
+            # consume) the successor's packet, running two DKGs at once
+            fut = self._group_packet = \
+                asyncio.get_event_loop().create_future()
             await self._signal_leader(leader, secret, b"", timeout)
-            packet, leader_ident = await asyncio.wait_for(
-                self._group_packet, timeout)
+            packet, leader_ident = await asyncio.wait_for(fut, timeout)
             group = verify_group_packet(leader_ident, packet)
             if group.find(self.priv.public) is None:
                 raise DrandError("we are not part of the pushed group")
@@ -218,12 +231,13 @@ class Drand(ProtocolService):
         token = self._acquire_setup(force)
         try:
             self._expected_secret = secret
-            self._group_packet = asyncio.get_event_loop().create_future()
+            # local binding: see init_dkg_follower (forced-preemption race)
+            fut = self._group_packet = \
+                asyncio.get_event_loop().create_future()
             if not leaving:
                 await self._signal_leader(leader, secret, old_group.hash(),
                                           timeout)
-            packet, leader_ident = await asyncio.wait_for(
-                self._group_packet, timeout)
+            packet, leader_ident = await asyncio.wait_for(fut, timeout)
             group = verify_group_packet(leader_ident, packet)
             if old_group.find(leader_ident) is None:
                 raise DrandError("reshare leader not part of the old group")
@@ -247,23 +261,43 @@ class Drand(ProtocolService):
         if self.beacon is not None:
             self.beacon.stop()
 
-    async def follow_chain(self, peers: list[str], up_to: int = 0) -> bool:
+    async def follow_chain(self, peers: list[str], up_to: int = 0,
+                           info_hash: bytes | None = None) -> bool:
         """Sync the chain from peers without participating
         (core/drand_control.go:783 StartFollowChain): fetch+pin the chain
-        info, then stream/verify/store beacons."""
+        info, then stream/verify/store beacons.
+
+        ``info_hash``: the operator-supplied chain hash — the SOLE trust
+        anchor of a follow (the peers themselves are untrusted). Chain
+        info served by a peer is validated against it before anything is
+        pinned or stored (core/drand_control.go:822-829); a peer serving
+        mismatched info is skipped like an unreachable one, and the
+        follow aborts when no peer serves matching info."""
         from ..chain.engine.sync import Syncer
         from ..chain.store import CallbackStore, genesis_beacon
 
         if not peers:
             raise DrandError("follow needs at least one peer")
         info = None
+        mismatched = 0
         for p in peers:
             try:
-                info = await self.client.chain_info(_addr_peer(p))
-                break
+                got = await self.client.chain_info(_addr_peer(p))
             except TransportError:
                 continue
+            if info_hash and got.hash() != info_hash:
+                mismatched += 1
+                self._l.warn("follow", "chain_info_hash_mismatch", peer=p,
+                             expected=info_hash.hex(),
+                             got=got.hash().hex())
+                continue
+            info = got
+            break
         if info is None:
+            if mismatched:
+                raise DrandError(
+                    f"chain info hash mismatch on {mismatched} peer(s) — "
+                    "refusing to follow an unpinned chain")
             raise DrandError("no peer served chain info")
         db = self.conf.db_file()
         if db:

@@ -225,8 +225,21 @@ def verify_beacons(pubkey: PointG1, beacons,
     if _use_device(len(beacons)):
         try:
             _note_dispatch("verify_beacons")
-            with _timed("verify_beacons", "device", len(beacons)):
-                out = engine().verify_beacons(pubkey, beacons, dst)
+            eng = engine()
+            out = None
+            n_checks = sum(1 + (1 if b.is_v2() else 0) for b in beacons)
+            if eng.wire_rlc_active(n_checks):
+                # wire-RLC tier: device h2c + in-graph lane-MSM collapse
+                # the span to ONE 2-pairing row (ops/engine.py). A None
+                # return is the false-reject-only fallback — re-dispatch
+                # below through the per-item wire graph for exact
+                # verdicts, under its own path label.
+                with _timed("verify_beacons", "wire_rlc", len(beacons)):
+                    out = eng.verify_beacons_wire_rlc(pubkey, beacons, dst)
+            if out is None:
+                with _timed("verify_beacons", "device", len(beacons)):
+                    out = eng.verify_beacons(pubkey, beacons, dst,
+                                             try_wire_rlc=False)
             _note_device_ok()
             return out
         except Exception as e:  # noqa: BLE001 — host path is the oracle

@@ -41,12 +41,19 @@ Soundness (why this is safe):
 - A zero scalar would delete its item from the check entirely, so
   scalars are drawn nonzero.
 
-On batch failure the span bisects (each half re-checked with FRESH
-scalars) down to single items, which are decided by the exact per-item
-oracle (tbls.verify_partial / tbls.verify_recovered) — the returned
-bool array is therefore bit-identical to the per-item path on every
-input, and an all-valid span (the overwhelmingly common case) costs
-exactly one product check.
+On batch failure the span bisects BATCHED: both halves are decided by
+ONE grouped 4-pairing product check (fresh scalars per half, one shared
+Miller pass — pairing.pairing_check_groups) instead of two sequential
+2-pairing dispatches, recursing down to single items, which are decided
+by the exact per-item oracle (tbls.verify_partial /
+tbls.verify_recovered) — the returned bool array is therefore
+bit-identical to the per-item path on every input, and an all-valid
+span (the overwhelmingly common case) costs exactly one product check.
+
+The combine MSMs run the ψ-endomorphism-split Pippenger (``msm``
+below): G2 spans halve their 128-bit scalars through ψ and collapse
+through the bucket method, with the original interleaved-window ladder
+(``msm_window``) kept as the validation/bench reference.
 
 Dispatch policy (which path runs when) lives in crypto/batch.py; the
 device-graph version of the same combination lives in ops/engine.py.
@@ -60,8 +67,9 @@ import numpy as np
 
 from . import endo, tbls
 from .curves import PointG1, PointG2, _JacobianPoint
+from .fields import X_BLS
 from .hash_to_curve import DEFAULT_DST_G2, hash_to_g2
-from .pairing import pairing_check
+from .pairing import pairing_check, pairing_check_groups
 from .poly import PubPoly
 
 RLC_SCALAR_BITS = 128
@@ -101,17 +109,45 @@ def decode_sig(sig_bytes: bytes) -> PointG2 | None:
 
 
 # ---------------------------------------------------------------------------
-# Host MSM: interleaved 4-bit windows with one shared doubling chain —
-# ~46 point-adds per item + 124 shared doublings for 128-bit scalars,
-# vs ~192 ops per item for independent double-and-add. This is the term
-# that must stay well under a Miller loop for the >=5x span speedup.
+# Host MSM. Three layers:
+#
+# - ``msm_window``: the original interleaved 4-bit-window ladder (~46
+#   point-adds per item + shared doublings) — kept as the small-span
+#   fallback and as the bench/test reference the faster paths are
+#   measured and validated against.
+# - ``msm_pippenger``: the bucket method — per window, points land in
+#   2^c - 1 digit buckets which collapse with one suffix-sum sweep, so
+#   the add count is ~nwin*(n + 2^(c+1)) + nbits doublings, sublinear
+#   per item once n outgrows the bucket overhead (window width ``c``
+#   sized for n in [2, 1024]).
+# - ``msm_endo_g2``: the ψ-endomorphism split for G2 spans. ψ acts as
+#   multiplication by the BLS parameter x on the r-order subgroup
+#   (crypto/endo.py), so with M = -x (63.7 bits) every 128-bit RLC
+#   scalar c = q·M + rem becomes two <= _ENDO_Q_BITS-bit scalars on
+#   (P, -ψ(P)) — HALF the doubling chain and half the window passes for
+#   twice the (cheap, bucketed) points. The whole span is normalized
+#   with ONE simultaneous inversion (batch_to_affine) so ψ costs two
+#   Fp2 multiplications per point.
+#
+# ``msm`` dispatches: G2 spans split through ψ, then bucket-vs-window by
+# effective size. This is the term that must stay well under a Miller
+# loop for the span speedup.
 # ---------------------------------------------------------------------------
 
 _MSM_WINDOW = 4
+# ψ-split parameters: c = q·M + rem with M = -x > 0; q <= (2^128-1)//M
+_ENDO_M = -X_BLS
+assert _ENDO_M > 0
+_ENDO_Q_BITS = (((1 << RLC_SCALAR_BITS) - 1) // _ENDO_M).bit_length()
+# below this many (post-split) points the windowed ladder's lower fixed
+# overhead beats the bucket sweep
+_PIPPENGER_MIN = 16
 
 
-def msm(points: list[_JacobianPoint], scalars: list[int]):
-    """sum_i scalars_i * points_i for nonnegative scalars < 2^128."""
+def msm_window(points: list[_JacobianPoint], scalars: list[int],
+               nbits: int = RLC_SCALAR_BITS):
+    """sum_i scalars_i * points_i for nonnegative scalars < 2^nbits —
+    interleaved windows, one shared doubling chain (the reference MSM)."""
     if not points:
         raise ValueError("empty MSM")
     cls = type(points[0])
@@ -123,7 +159,7 @@ def msm(points: list[_JacobianPoint], scalars: list[int]):
             tbl[k] = tbl[k - 1] + p
         tables.append(tbl)
     acc = cls.infinity()
-    nwin = (RLC_SCALAR_BITS + _MSM_WINDOW - 1) // _MSM_WINDOW
+    nwin = (nbits + _MSM_WINDOW - 1) // _MSM_WINDOW
     for win in range(nwin - 1, -1, -1):
         if win != nwin - 1:
             for _ in range(_MSM_WINDOW):
@@ -136,14 +172,111 @@ def msm(points: list[_JacobianPoint], scalars: list[int]):
     return acc
 
 
+def _pip_window(n: int) -> int:
+    """Bucket width by span size (cost ~nwin*(n + 2^(c+1)): the optimum
+    grows with log n; table tuned for the N in [2, 1024] dispatch range."""
+    if n < 24:
+        return 3
+    if n < 80:
+        return 4
+    if n < 256:
+        return 5
+    if n < 900:
+        return 6
+    return 7
+
+
+def msm_pippenger(points: list[_JacobianPoint], scalars: list[int],
+                  nbits: int = RLC_SCALAR_BITS):
+    """Bucket-method MSM: sum_i scalars_i * points_i, scalars < 2^nbits."""
+    if not points:
+        raise ValueError("empty MSM")
+    cls = type(points[0])
+    c = _pip_window(len(points))
+    nwin = (nbits + c - 1) // c
+    mask = (1 << c) - 1
+    acc = None
+    for win in range(nwin - 1, -1, -1):
+        if acc is not None:
+            for _ in range(c):
+                acc = acc.double()
+        shift = win * c
+        buckets: list = [None] * (mask + 1)
+        for p, s in zip(points, scalars):
+            d = (s >> shift) & mask
+            if d:
+                buckets[d] = p if buckets[d] is None else buckets[d] + p
+        # suffix sweep: total = sum_d d * bucket[d]
+        running = total = None
+        for d in range(mask, 0, -1):
+            if buckets[d] is not None:
+                running = (buckets[d] if running is None
+                           else running + buckets[d])
+            if running is not None:
+                total = running if total is None else total + running
+        if total is not None:
+            acc = total if acc is None else acc + total
+    return cls.infinity() if acc is None else acc
+
+
+def _endo_split_g2(points: list[PointG2], scalars: list[int]):
+    """(points, 128-bit scalars) -> (2x points, <= _ENDO_Q_BITS scalars)
+    via c·P = rem·P + q·(-ψ(P)) where c = q·M + rem, M = -x (ψ(P) = [x]P
+    on the r-order subgroup — every caller feeds subgroup-checked
+    points: decode_sig's prefilter or hash_to_g2 outputs)."""
+    xys = PointG2.batch_to_affine(points)
+    pts2: list[PointG2] = []
+    sc2: list[int] = []
+    for (x, y), p, s in zip(xys, points, scalars):
+        q, rem = divmod(s, _ENDO_M)
+        if rem:
+            pts2.append(p)
+            sc2.append(rem)
+        if q:
+            pts2.append(-endo.psi_from_affine(x, y))
+            sc2.append(q)
+    return pts2, sc2
+
+
+def msm(points: list[_JacobianPoint], scalars: list[int]):
+    """sum_i scalars_i * points_i for nonnegative scalars < 2^128 — the
+    RLC combine dispatcher: G2 spans ψ-split to ~64-bit scalars, then
+    bucket method above _PIPPENGER_MIN effective points, windowed ladder
+    below. Bit-exact with msm_window on every input (pure regrouping of
+    the same group operation)."""
+    if not points:
+        raise ValueError("empty MSM")
+    cls = type(points[0])
+    live = [(p, s) for p, s in zip(points, scalars)
+            if s and not p.is_infinity()]
+    if not live:
+        return cls.infinity()
+    pts = [p for p, _ in live]
+    scs = [s for _, s in live]
+    nbits = RLC_SCALAR_BITS
+    if isinstance(pts[0], PointG2):
+        pts, scs = _endo_split_g2(pts, scs)
+        nbits = _ENDO_Q_BITS
+        if not pts:
+            return cls.infinity()
+    if len(pts) >= _PIPPENGER_MIN:
+        return msm_pippenger(pts, scs, nbits)
+    return msm_window(pts, scs, nbits)
+
+
 # ---------------------------------------------------------------------------
 # The recursive span check
 # ---------------------------------------------------------------------------
 
-def _rlc_pass(items, fixed_g1: PointG1 | None, msg_pt: PointG2 | None) -> bool:
-    """One product check over ``items`` = [(pos, sig_pt, other)] where
-    ``other`` is H(m_i) (fixed_g1 set: one-key-many-messages shape) or
-    pk_i (msg_pt set: one-message-many-keys shape)."""
+def _combine(items, fixed_g1: PointG1 | None, msg_pt: PointG2 | None):
+    """The 2-pairing product check over ``items`` = [(pos, sig_pt,
+    other)] as pairing pairs with FRESH scalars, where ``other`` is
+    H(m_i) (fixed_g1 set: one-key-many-messages shape) or pk_i (msg_pt
+    set: one-message-many-keys shape). None when a combination
+    degenerates to infinity — a vacuously-degenerate combination must
+    never decide a span, so callers treat None as a failed check and
+    bisect down to the per-item oracle (for honest inputs this has
+    ~2^-128 probability)."""
     cs = rlc_scalars(len(items))
     s_comb = msm([sig for _, sig, _ in items], cs)
     if fixed_g1 is not None:
@@ -153,18 +286,18 @@ def _rlc_pass(items, fixed_g1: PointG1 | None, msg_pt: PointG2 | None) -> bool:
         g1_side = msm([other for _, _, other in items], cs)
         g2_side = msg_pt
     if s_comb.is_infinity() or g1_side.is_infinity() or g2_side.is_infinity():
-        # a vacuously-degenerate combination must never decide a span —
-        # report failure so the caller bisects down to the per-item oracle
-        # (for honest inputs this has ~2^-128 probability)
-        return False
-    return pairing_check([(-PointG1.generator(), s_comb),
-                          (g1_side, g2_side)])
+        return None
+    return [(-PointG1.generator(), s_comb), (g1_side, g2_side)]
+
+
+def _rlc_pass(items, fixed_g1: PointG1 | None, msg_pt: PointG2 | None) -> bool:
+    pairs = _combine(items, fixed_g1, msg_pt)
+    return pairs is not None and pairing_check(pairs)
 
 
 def _resolve(items, out: list[bool], leaf, fixed_g1, msg_pt) -> None:
     """Mark out[pos] for every item: one RLC check per all-valid span,
-    bisection (fresh scalars per sub-span) otherwise, per-item oracle at
-    the leaves."""
+    batched bisection otherwise, per-item oracle at the leaves."""
     if not items:
         return
     if len(items) == 1:
@@ -175,9 +308,34 @@ def _resolve(items, out: list[bool], leaf, fixed_g1, msg_pt) -> None:
         for pos, _, _ in items:
             out[pos] = True
         return
+    _bisect(items, out, leaf, fixed_g1, msg_pt)
+
+
+def _bisect(items, out: list[bool], leaf, fixed_g1, msg_pt) -> None:
+    """``items``' combined check just failed: decide BOTH halves with
+    one grouped 4-pairing product check (fresh scalars per half —
+    pairing.pairing_check_groups shares the Miller pass) instead of two
+    sequential 2-pairing dispatches, then recurse into failing halves
+    without re-checking them. Singleton halves go straight to the exact
+    per-item oracle, so the bool output stays bit-identical to the
+    per-item loop on every input."""
     mid = len(items) // 2
-    _resolve(items[:mid], out, leaf, fixed_g1, msg_pt)
-    _resolve(items[mid:], out, leaf, fixed_g1, msg_pt)
+    checks = []  # (half, pairs-or-None) awaiting the grouped verdict
+    for half in (items[:mid], items[mid:]):
+        if len(half) == 1:
+            pos = half[0][0]
+            out[pos] = leaf(pos)
+            continue
+        checks.append((half, _combine(half, fixed_g1, msg_pt)))
+    live = [pairs for _, pairs in checks if pairs is not None]
+    verdicts = iter(pairing_check_groups(live) if live else ())
+    for half, pairs in checks:
+        ok = next(verdicts) if pairs is not None else False
+        if ok:
+            for pos, _, _ in half:
+                out[pos] = True
+        else:
+            _bisect(half, out, leaf, fixed_g1, msg_pt)
 
 
 # ---------------------------------------------------------------------------

@@ -61,7 +61,15 @@ def psi(q: PointG2) -> PointG2:
     """ψ(Q) for any Q on the twist (not only the r-order subgroup)."""
     if q.is_infinity():
         return q
-    x, y = q.to_affine()
+    return psi_from_affine(*q.to_affine())
+
+
+def psi_from_affine(x: Fp2, y: Fp2) -> PointG2:
+    """ψ applied to known-affine coordinates — the batch entry for the
+    host MSM's endomorphism split (crypto/batch_verify.msm_endo_g2):
+    callers normalize a whole span with one simultaneous inversion
+    (PointG2.batch_to_affine) and apply ψ per point without the per-point
+    inverse that :func:`psi`'s to_affine would pay."""
     return PointG2(PSI_CX * x.conjugate(), PSI_CY * y.conjugate(), Fp2.one())
 
 

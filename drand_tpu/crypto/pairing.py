@@ -126,38 +126,10 @@ def miller_loop(pairs: list[tuple[PointG1, PointG2]]) -> Fp12:
     """Shared-squaring Miller loop over |x| for a list of (P, Q) pairs.
 
     Points must not be at infinity (callers filter; pairing() handles it).
+    One group of :func:`miller_loop_groups` — a single Miller-loop
+    implementation serves both the plain and the grouped product checks.
     """
-    p_affs = []
-    q_affs = []
-    for pt, q in pairs:
-        xa, ya = pt.to_affine()
-        p_affs.append((xa.v, ya.v))
-        q_affs.append(q.to_affine())
-
-    ts = list(q_affs)  # running T, affine on the twist
-    f = Fp12.one()
-    three = 3
-    for bit in _MILLER_BITS:
-        f = f.square()
-        for i in range(len(pairs)):
-            xt, yt = ts[i]
-            # doubling: lam2 = 3 x^2 / (2 y)
-            lam2 = xt.square().mul_scalar(three) * (yt + yt).inverse()
-            f = f * _line_value(ts[i], lam2, p_affs[i])
-            x3 = lam2.square() - xt - xt
-            y3 = lam2 * (xt - x3) - yt
-            ts[i] = (x3, y3)
-        if bit == "1":
-            for i in range(len(pairs)):
-                xt, yt = ts[i]
-                xq, yq = q_affs[i]
-                lam2 = (yq - yt) * (xq - xt).inverse()
-                f = f * _line_value(ts[i], lam2, p_affs[i])
-                x3 = lam2.square() - xt - xq
-                y3 = lam2 * (xt - x3) - yt
-                ts[i] = (x3, y3)
-    # x < 0: conjugate (inverse up to the easy part of the final exp)
-    return f.conjugate()
+    return miller_loop_groups([pairs])[0]
 
 
 # ---------------------------------------------------------------------------
@@ -215,6 +187,67 @@ def multi_pairing(pairs: list[tuple[PointG1, PointG2]], canonical: bool = True) 
 def pairing(p: PointG1, q: PointG2) -> Fp12:
     """The canonical optimal-ate pairing e(P, Q)."""
     return multi_pairing([(p, q)])
+
+
+def miller_loop_groups(groups: list[list[tuple[PointG1, PointG2]]]) -> list[Fp12]:
+    """Per-group Miller values in ONE pass over the |x| bits: line/T
+    updates are per-pair exactly as in :func:`miller_loop`, but each
+    group keeps its own accumulator (squared per bit), so one invocation
+    yields independent products. Points must not be at infinity (callers
+    filter). Empty groups yield Fp12.one()."""
+    flat = [(g, p, q) for g, grp in enumerate(groups) for (p, q) in grp]
+    p_affs, q_affs, gids = [], [], []
+    for g, pt, q in flat:
+        xa, ya = pt.to_affine()
+        p_affs.append((xa.v, ya.v))
+        q_affs.append(q.to_affine())
+        gids.append(g)
+
+    ts = list(q_affs)
+    fs = [Fp12.one()] * len(groups)
+    three = 3
+    for bit in _MILLER_BITS:
+        fs = [f.square() for f in fs]
+        for i, g in enumerate(gids):
+            xt, yt = ts[i]
+            lam2 = xt.square().mul_scalar(three) * (yt + yt).inverse()
+            fs[g] = fs[g] * _line_value(ts[i], lam2, p_affs[i])
+            x3 = lam2.square() - xt - xt
+            y3 = lam2 * (xt - x3) - yt
+            ts[i] = (x3, y3)
+        if bit == "1":
+            for i, g in enumerate(gids):
+                xt, yt = ts[i]
+                xq, yq = q_affs[i]
+                lam2 = (yq - yt) * (xq - xt).inverse()
+                fs[g] = fs[g] * _line_value(ts[i], lam2, p_affs[i])
+                x3 = lam2.square() - xt - xq
+                y3 = lam2 * (xt - x3) - yt
+                ts[i] = (x3, y3)
+    return [f.conjugate() for f in fs]
+
+
+def pairing_check_groups(groups: list[list[tuple[PointG1, PointG2]]]
+                         ) -> list[bool]:
+    """Independent product checks (prod e(P_i, Q_i) == 1 per group)
+    decided in ONE grouped Miller pass — the batched-bisection primitive:
+    a failed RLC span verifies BOTH halves as one 4-pairing dispatch
+    instead of two sequential 2-pairing checks. Counts as one product
+    check at the meter (one invocation; the per-pair Miller work is what
+    N_MILLER_PAIRS tracks). A group whose pairs are all infinity-filtered
+    is vacuously True, matching pairing_check on the same input."""
+    live_groups = [[(p, q) for (p, q) in grp
+                    if not p.is_infinity() and not q.is_infinity()]
+                   for grp in groups]
+    if not any(live_groups):
+        return [True] * len(groups)
+    global N_PRODUCT_CHECKS, N_MILLER_PAIRS
+    N_PRODUCT_CHECKS += 1
+    N_MILLER_PAIRS += sum(len(g) for g in live_groups)
+    fs = miller_loop_groups(live_groups)
+    return [final_exponentiation(f, canonical=False).is_one()
+            if grp else True
+            for f, grp in zip(fs, live_groups)]
 
 
 def pairing_check(pairs: list[tuple[PointG1, PointG2]]) -> bool:

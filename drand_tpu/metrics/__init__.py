@@ -33,7 +33,10 @@ Additions beyond the reference (the TPU engine + round tracing):
       latency, batch-size-bucketed (crypto/batch.py dispatch wrappers);
       path="host_rlc" marks the randomized-linear-combination batch
       verifier (crypto/batch_verify.py — one 2-pairing product check
-      for a whole span instead of one per item)
+      for a whole span instead of one per item); path="wire_rlc" the
+      device wire-pipeline RLC tier (ops/engine.py verify_wire_rlc —
+      device hash-to-curve + in-graph lane-MSM, 2 Miller pairs per
+      catch-up span with no host hashing)
   hash_to_g2_cache_requests{result}    [private] hash-to-G2 memo
       hit/miss counters (crypto/hash_to_curve.py per-round keyed LRU)
 

@@ -201,6 +201,15 @@ class ControlServer:
             return
         peers = list(req.get("nodes") or [])
         up_to = int(req.get("up_to") or 0)
+        # the operator-supplied chain hash is the follow's sole trust
+        # anchor (core/drand_control.go:822-829): decode it up front and
+        # make follow_chain validate every peer's chain info against it
+        try:
+            info_hash = bytes.fromhex(req.get("info_hash") or "")
+        except ValueError:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                "info_hash: not valid hex")
+            return
 
         def last_round() -> int:
             # progress of the FOLLOW sync itself (daemon._follow_store),
@@ -215,7 +224,8 @@ class ControlServer:
                 return 0
 
         self._d._follow_store = None  # don't report a previous follow
-        task = asyncio.ensure_future(self._d.follow_chain(peers, up_to))
+        task = asyncio.ensure_future(
+            self._d.follow_chain(peers, up_to, info_hash=info_hash or None))
         try:
             while not task.done():
                 yield pw.encode(pw.FOLLOW_PROGRESS,
@@ -309,10 +319,17 @@ class ControlServer:
 
     async def _follow(self, req: dict) -> dict:
         """StartFollowChain analogue (core/drand_control.go:783): sync the
-        chain from peers without participating."""
+        chain from peers without participating. ``info_hash`` (hex) pins
+        the chain — peers serving mismatching chain info are rejected."""
         up_to = int(req.get("up_to", 0))
         peers = req.get("peers", [])
-        ok = await self._d.follow_chain(peers, up_to)
+        try:
+            info_hash = bytes.fromhex(req.get("info_hash") or "")
+        except ValueError as e:
+            # same contract as the protobuf StartFollowChain endpoint
+            raise ValueError("info_hash: not valid hex") from e
+        ok = await self._d.follow_chain(peers, up_to,
+                                        info_hash=info_hash or None)
         return {"ok": ok, "last": (await self._status({}))["last_round"]}
 
 
@@ -385,6 +402,10 @@ class ControlClient:
     async def shutdown(self) -> dict:
         return await self._call("Shutdown", {})
 
-    async def follow(self, peers: list[str], up_to: int = 0) -> dict:
-        return await self._call("Follow", {"peers": peers, "up_to": up_to},
+    async def follow(self, peers: list[str], up_to: int = 0,
+                     info_hash: str = "") -> dict:
+        """``info_hash``: hex chain hash pinning the followed chain —
+        the daemon rejects peers whose chain info hashes differently."""
+        return await self._call("Follow", {"peers": peers, "up_to": up_to,
+                                           "info_hash": info_hash},
                                 timeout=3600)

@@ -143,6 +143,80 @@ _USUB_PROFILES = {
 }
 
 
+# ---- the authoritative redc input ceiling --------------------------------
+#
+# The largest value any lazy chain feeds ``redc`` is a "Z"-site
+# subtraction output (f12_mul's c1 / f12_sqr's c0): an _u_sub at site S
+# bounds its result value by  x.value + W(S) + p  where W(S) is the
+# site's whole complement-profile total (comp = C_S - y <= W(S), plus
+# the D_S < p addend). With the x side's f12-level coefficient bound
+# covered by 2^777 (annotated <= 2^776.2 at both call sites), the exact
+# worst case is
+#
+#     REDC_VALUE_CEILING = 2^777 + W("Z") + p   (~2^778.59)
+#
+# — ABOVE the 2^778 the old docstring chain covered and the 2^778.5 the
+# tests claimed (ADVICE r5 low finding: the stated proof did not reach
+# the actual worst case). The wrap-chain convergence for this ceiling is
+# re-verified statically below (see _redc_wrap_converges); ``redc``'s
+# wrap_passes=6 leaves two passes of proven margin over the 4 the chain
+# needs.
+
+def _usub_value_ceiling(site: str, x_value_bound: int) -> int:
+    prof = _USUB_PROFILES[site]
+    w_total = sum(c << (12 * k) for k, c in enumerate(prof))
+    return x_value_bound + w_total + P
+
+
+REDC_VALUE_CEILING = _usub_value_ceiling("Z", 1 << 777)
+
+
+def _redc_wrap_converges(value_bound: int, wrap_passes: int,
+                         width: int = _UW) -> bool:
+    """Exact-integer certificate that ``redc(t, wrap_passes)`` of a lazy
+    value <= value_bound truncates no live carry limb. Sound per-pass
+    model of _wrap (all ints, no floats):
+
+    - after the 3-round folds, limbs are <= MASK + 1 (the ripple), so
+      the low 32 limbs hold at most LO_CAP = (MASK+1)·(2^384−1)/MASK;
+    - substitution upper bound: v' <= LO_CAP + Σ_i hi_i·row_i with
+      hi_i <= min(MASK+1, v >> (384+12i)) and row_i = 2^(12(32+i)) mod p;
+    - substitution descent: the hi limbs hold at least v − LO_CAP, and
+      replacing 2^384 by row_0 removes >= 2^384 − row_0 per unit, so
+      v' <= v − ceil((v − LO_CAP)/2^384)·(2^384 − row_0);
+    - wrap never increases the value, and once v < 2^384 every later
+      fold keeps the grown carry limb at zero (a nonzero limb 32 would
+      contribute >= 2^384 to a value that is preserved exactly), so the
+      final [:32] truncation is exact.
+
+    The model takes the min of the three bounds per pass and requires
+    the value bound to land below 2^384 by the end of the pass chain."""
+    r384 = 1 << (12 * NLIMBS)
+    limb_cap = MASK + 1
+    lo_cap = limb_cap * (r384 - 1) // MASK
+    rows = [(1 << (12 * (NLIMBS + i))) % P for i in range(width)]
+    # the REDC tail ahead of the wrap: u = t + m·p with m < 2^384,
+    # r = u / 2^384 (exact division), first wrap pass sees 4 hi limbs
+    v = (value_bound + r384 * P) >> (12 * NLIMBS)
+    hi_w = 4
+    for _ in range(wrap_passes):
+        if v < r384:
+            return True
+        sub = lo_cap + sum(min(limb_cap, v >> (12 * (NLIMBS + i))) * rows[i]
+                           for i in range(hi_w))
+        hi_units = -(-(v - lo_cap) // r384) if v > lo_cap else 0
+        desc = v - hi_units * (r384 - rows[0]) if hi_units else v
+        v = min(v, sub, max(desc, 0))
+        hi_w = 1  # passes after the first leave a single grown carry limb
+    return v < r384
+
+
+if not _redc_wrap_converges(REDC_VALUE_CEILING, wrap_passes=6):
+    raise AssertionError(
+        "redc wrap chain does not cover the Z-site worst case — a limb "
+        "profile bump exceeded REDC_VALUE_CEILING's proven convergence")
+
+
 def _usub_rows():
     out = []
     for name, prof in _USUB_PROFILES.items():
@@ -578,12 +652,17 @@ def _u_xi(pair, site: str):
 
 def redc(t, wrap_passes: int = 6):
     """REDC of a lazy value: non-negative limbs < 2^30, any width in
-    [64, _UW], value < ~2^778. Identical algorithm to :func:`mont_mul`'s
-    tail; ``wrap_passes`` = 6 covers value bounds to 2^778 (worst-case
-    chain 2^778 -> r < 2^394 -> Σhi <= 1261 -> 181p -> 26p -> 4p -> 1p
-    -> < 2^384, each pass shrinking by ~p/2^384 ≈ 1/7; the final pass
-    provably zeroes the carry limb so the [:32] truncation is exact —
-    the reduce_light 3-pass lesson applied at this scale)."""
+    [64, _UW], value <= REDC_VALUE_CEILING (~2^778.59 — the authoritative
+    input bound, derived from the "Z"-site worst case where the profiles
+    are built; the 2^778/2^778.1/2^778.5 figures previously scattered
+    across docstrings and tests all sat BELOW the true worst case).
+    Identical algorithm to :func:`mont_mul`'s tail. ``wrap_passes`` = 6
+    covers the ceiling with two passes of margin: the statically-checked
+    chain (_redc_wrap_converges, exact ints) is 2^778.59 -> r < 2^394.6
+    -> 1300p -> 121p -> 13p -> 3.2p < 2^384 after pass 4, and once the
+    value bound is under 2^384 the remaining passes preserve it, so the
+    grown carry limb is provably zero and the [:32] truncation exact —
+    the reduce_light 3-pass lesson applied at this scale."""
     t = _fold(t, rounds=3, grow=True)              # limbs <= MASK+1
     m = _conv(t[..., :NLIMBS, :], jnp.broadcast_to(
         _crow("NPRIME"), t.shape[:-2] + (NLIMBS, t.shape[-1])), NLIMBS)
@@ -840,7 +919,7 @@ def f12_mul(a, b):
         #   -> <= 2^23.8 limbs / 2^776.2 value
         c0 = _u_add6(v0, _u_mul_by_v(v1, "Y"))
         # c1 = v2 - (v0+v1): "Z" (y <= 2^23.3/2^775.2)
-        #   -> <= 2^24.4 / 2^778.1
+        #   -> <= 2^24.4 / 2^778.1 (under REDC_VALUE_CEILING ~2^778.59)
         c1 = _u_sub6(v2, _u_add6(v0, v1), "Z")
         r = _redc_pairs(c0 + c1)  # (..., 6, 2, 32, B)
         return f12(r[..., :3, :, :, :], r[..., 3:, :, :, :])
@@ -862,7 +941,7 @@ def f12_sqr(a):
         v0 = _u_prod(cs, 0)   # a0*a1
         w = _u_prod(cs, 1)    # (a0+a1)(a0+v*a1)
         # c0 = w - (v0 + v*v0): y <= 2^23.8/2^776.2, "Z" -> c0 <=
-        # 2^24.4 limbs / 2^778.1 value (redc wrap_passes=6 ceiling)
+        # 2^24.4 limbs / 2^778.1 value (under REDC_VALUE_CEILING ~2^778.59)
         c0 = _u_sub6(w, _u_add6(v0, _u_mul_by_v(v0, "Y")), "Z")
         c1 = _u_add6(v0, v0)
         r = _redc_pairs(c0 + c1)
@@ -924,7 +1003,7 @@ def _f12_cyclotomic_sqr_lazy(a):
     and the two cannot be combined pre-REDC without an extra lifting
     convolution that would cost the saving back. Bounds: lazy squares
     <= 2^18.2/2^769.2 after fold; A/B <= 2^20.6 limbs / <= 2^773.3
-    value ("T" subs) — under redc's 2^30 / 2^778 ceilings."""
+    value ("T" subs) — under redc's 2^30 / REDC_VALUE_CEILING ceilings."""
     w = f12_to_w(a)
     g = [w[..., i, :, :, :] for i in range(6)]
     rows_a, rows_b = [], []

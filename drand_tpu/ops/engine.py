@@ -66,9 +66,31 @@ WIRE_MAX_BUCKET = 128
 # per-call, from the host CSPRNG. Lane counts are bucketed (one compile
 # per bucket), and spans below ENGINE_RLC_MIN keep the per-item graphs
 # (one dispatch either way; the per-shape compile isn't worth it).
+# The WIRE tier folds the same combination into the wire pipeline
+# (verify_wire_rlc): device hash-to-curve + decompression feed an
+# in-graph lane MSM, so catch-up costs 2 Miller loops end-to-end with no
+# host hashing either — dispatched by crypto/batch.py under
+# engine_op_seconds{path="wire_rlc"} with false-reject-only fallback to
+# the per-item wire graph.
 RLC_NBITS = batch_verify.RLC_SCALAR_BITS
 RLC_LANE_BUCKETS = (8, 32, 128, 512)
 ENGINE_RLC_MIN = int(os.environ.get("DRAND_TPU_ENGINE_RLC_MIN", "8"))
+
+# Device pairing-row meter — the device twin of crypto/pairing.py's
+# N_PRODUCT_CHECKS/N_MILLER_PAIRS: every row of a dispatched verify
+# graph is one 2-pairing product check executed on device, so tests and
+# bench can PROVE Miller-loop claims ("an all-valid wire_rlc catch-up
+# span costs exactly 2 Miller pairs, was 2N") without monkeypatching
+# graphs. Counted at the public dispatch entrypoints only; known-answer
+# probes go through the internal launchers and are not counted.
+N_PRODUCT_CHECKS = 0   # verify-graph dispatches
+N_MILLER_PAIRS = 0     # 2 x requested rows across those dispatches
+
+
+def _meter_rows(n: int) -> None:
+    global N_PRODUCT_CHECKS, N_MILLER_PAIRS
+    N_PRODUCT_CHECKS += 1
+    N_MILLER_PAIRS += 2 * n
 
 
 def _drain(launches) -> np.ndarray:
@@ -192,6 +214,10 @@ class BatchedEngine:
         self._rlc_ok: dict[tuple, bool] = {}
         self._rlc_g2g2_jit = jax.jit(self._rlc_combine_g2g2_graph)
         self._rlc_g1g2_jit = jax.jit(self._rlc_combine_g1g2_graph)
+        # wire-RLC: the combine runs AFTER device hash-to-curve, so a
+        # catch-up span needs no host hashing at all (see verify_wire_rlc)
+        self._wire_rlc_ok: dict[int, bool] = {}
+        self._wire_rlc_jit = jax.jit(self._wire_rlc_graph)
 
     @staticmethod
     def _wire_graph(pub_aff, sig_x, sig_sign, u_pairs):
@@ -208,6 +234,33 @@ class BatchedEngine:
         msg_aff = jnp.stack([mx, my], axis=-3)
         ok = pairing.verify_prepared(pub_aff, sig_aff, msg_aff)
         return ok & on_curve & in_subgroup
+
+    @staticmethod
+    def _wire_rlc_graph(sig_x, sig_sign, u_pairs, live, bits):
+        """The wire-RLC combine from wire-format inputs, entirely on
+        device: decompress + subgroup-check the signatures, hash the
+        messages to G2, then collapse the bucket to (Σc·sig, Σc·H(m))
+        with two lane MSMs sharing the scalar vector. Lanes that fail
+        decode, hash to infinity, or are padding (``live`` false) are
+        masked to infinity in BOTH MSMs, so a bad encoding never poisons
+        the combination — it is simply reported False in ``ok``. Returns
+        (ok, sx, sy, sinf, mx, my, minf); the combined pair feeds the
+        ordinary KAT-gated verify_bls pairing bucket (2 Miller pairs for
+        the whole span)."""
+        from . import h2c
+
+        sig_pt, on_curve = h2c.decompress_g2_device(sig_x, sig_sign)
+        in_subgroup = h2c.subgroup_check_g2(sig_pt)
+        msg_pt = h2c.hash_to_g2_device(u_pairs)
+        ok = on_curve & in_subgroup & live & ~msg_pt[3]
+        dead = ~ok
+        sig_jac = (sig_pt[0], sig_pt[1], sig_pt[2], sig_pt[3] | dead)
+        msg_jac = (msg_pt[0], msg_pt[1], msg_pt[2], msg_pt[3] | dead)
+        sx, sy, sinf = curve.pt_to_affine(
+            curve.F2, curve.msm_lanes(curve.F2, sig_jac, bits))
+        mx, my, minf = curve.pt_to_affine(
+            curve.F2, curve.msm_lanes(curve.F2, msg_jac, bits))
+        return ok, sx, sy, sinf, mx, my, minf
 
     # -- hashing (host, memoized: the aggregator re-verifies the same round
     #    message for every partial) -----------------------------------------
@@ -540,6 +593,7 @@ class BatchedEngine:
         if b is None:
             raise RuntimeError(
                 "device engine: no bucket passed known-answer validation")
+        _meter_rows(n)
         launches = [self._launch_bucket(triples[i:i + b], b)
                     for i in range(0, n, b)]
         stacked = _drain(launches)
@@ -599,16 +653,26 @@ class BatchedEngine:
         return (np.asarray(dev) & valid)[:n]
 
     def verify_beacons(self, pubkey: PointG1, beacons,
-                       dst: bytes = DEFAULT_DST_G2) -> np.ndarray:
+                       dst: bytes = DEFAULT_DST_G2, *,
+                       try_wire_rlc: bool = True) -> np.ndarray:
         """Dual-verify a span of beacons (V1 chain message + V2 when present)
         in one flattened batch — the chain-catchup hot path
-        (client/verify.go:146-163 made parallel). Returns per-beacon bools."""
+        (client/verify.go:146-163 made parallel). Returns per-beacon bools.
+
+        ``try_wire_rlc=False`` skips the wire-RLC fast path — used by the
+        crypto/batch.py dispatcher, which attempts that tier itself under
+        its own ``engine_op_seconds{path="wire_rlc"}`` label so a clean
+        fallback doesn't pay the combine dispatch twice."""
         from ..chain import beacon as chain_beacon
 
         n_checks = sum(1 + (1 if bcn.is_v2() else 0) for bcn in beacons)
         use_wire = (self.wire_prep if self.wire_prep is not None
                     else n_checks >= PALLAS_MIN_BUCKET)
         if use_wire:
+            if try_wire_rlc and self._rlc_wanted(n_checks):
+                got = self.verify_beacons_wire_rlc(pubkey, beacons, dst)
+                if got is not None:
+                    return got
             checks = []  # (msg_bytes, sig_bytes)
             spans = []
             for bcn in beacons:
@@ -713,6 +777,7 @@ class BatchedEngine:
         if b is None:
             raise RuntimeError(
                 "device engine: no wire bucket passed validation")
+        _meter_rows(n)
         launches = [self._launch_wire_bucket(pubkey, checks[i:i + b], b, dst)
                     for i in range(0, n, b)]
         stacked = _drain(launches)
@@ -764,6 +829,170 @@ class BatchedEngine:
                          dst: bytes = DEFAULT_DST_G2) -> np.ndarray:
         dev, valid, n = self._launch_wire_bucket(pubkey, checks, b, dst)
         return (np.asarray(dev) & valid)[:n]
+
+    # ------------------------------------------------- wire-RLC tier
+    # The RLC combination folded INTO the wire pipeline: device
+    # hash-to-curve + decompression feed an in-graph lane-MSM, so a
+    # catch-up span costs 2 Miller loops end-to-end with no host hashing
+    # either (the host does only SHA-256 expansion, byte unpacking and
+    # scalar sampling). Same discipline as every other graph family:
+    # per-bucket KAT gate against the host MSM, and a wrong verdict can
+    # only be a false REJECT (the caller falls back to the per-item wire
+    # graph for exact verdicts).
+
+    def wire_rlc_active(self, n_checks: int) -> bool:
+        """True iff a span of ``n_checks`` wire checks takes the device
+        wire-RLC tier (env gate, engine floor, wire-prep mode) — the
+        dispatch/bench-facing twin of agg_rlc_active; the per-bucket KAT
+        gate still applies at dispatch time."""
+        use_wire = (self.wire_prep if self.wire_prep is not None
+                    else n_checks >= PALLAS_MIN_BUCKET)
+        return bool(use_wire) and self._rlc_wanted(n_checks)
+
+    def _wire_rlc_buckets(self):
+        # the lane-MSM's cross-lane fold needs power-of-two lanes
+        return tuple(b for b in self._wire_buckets() if not (b & (b - 1)))
+
+    def _combine_wire_chunk(self, checks, cs, b: int, dst: bytes):
+        """One combine dispatch of <= b wire checks: (decode-ok mask,
+        Σc·sig, Σc·H(m)) with host points, (mask, None, None) when no
+        lane survives decode, or None when a live combination
+        degenerates to infinity (fall back; ~2^-128 honest)."""
+        from . import h2c
+
+        n = len(checks)
+        pad_msg = b"drand-tpu-pad"
+        msgs = [m for m, _ in checks] + [pad_msg] * (b - n)
+        u = h2c.msgs_to_u(msgs, dst)
+        pad_sig = _PAD_SIG()
+        sigs = [s for _, s in checks] + [pad_sig] * (b - n)
+        xs, sign, valid = h2c.sigs_to_x(sigs)
+        live = valid.copy()
+        live[n:] = False
+        bits = np.zeros((b, RLC_NBITS), np.int32)
+        for i, c in enumerate(cs):
+            bits[i] = curve.scalar_to_bits(c, RLC_NBITS)
+        if _pallas_ok(b):
+            from . import pallas_wire
+
+            out = pallas_wire.wire_rlc_pl(u, xs, sign, live, bits)
+        else:
+            out = self._wire_rlc_jit(
+                jnp.asarray(xs), jnp.asarray(sign), jnp.asarray(u),
+                jnp.asarray(live), jnp.asarray(bits))
+        ok, sx, sy, sinf, mx, my, minf = (np.asarray(o) for o in out)
+        ok = ok.astype(bool)[:n]
+        if not ok.any():
+            return ok, None, None
+        if bool(sinf) or bool(minf):
+            return None
+        return ok, _g2_from_affine_dev(sx, sy), _g2_from_affine_dev(mx, my)
+
+    def _check_wire_rlc(self, b: int) -> bool:
+        """KAT one wire-RLC combine shape against the host MSM on fixed
+        signatures and scalars, including a malformed lane that must be
+        excluded from the combination. Gates usefulness, not soundness
+        (the pairing row is the separately-KAT-gated verify_bls bucket,
+        and a wrong combined point fails it)."""
+        ok = self._wire_rlc_ok.get(b)
+        if ok is not None:
+            return ok
+        from ..crypto import bls
+        from ..crypto.hash_to_curve import hash_to_g2
+
+        sk = 0x5A17
+        m1, m2 = b"engine-wire-rlc-a", b"engine-wire-rlc-b"
+        s1, s2 = bls.sign(sk, m1), bls.sign(sk, m2)
+        checks = [(m1, s1), (m2, s2)]
+        cs = [5, 7]
+        expect_mask = [True, True]
+        if b >= 3:  # malformed lane: rejected per-item, never combined
+            checks.append((b"engine-wire-rlc-bad", b"\x00" * 96))
+            cs.append(3)
+            expect_mask.append(False)
+        try:
+            got = self._combine_wire_chunk(checks, cs, b, DEFAULT_DST_G2)
+            if got is None:
+                ok = False
+            else:
+                mask, s_comb, m_comb = got
+                p1 = PointG2.from_bytes(s1, subgroup_check=False)
+                p2 = PointG2.from_bytes(s2, subgroup_check=False)
+                ok = (list(mask) == expect_mask
+                      and s_comb == p1.mul(5) + p2.mul(7)
+                      and m_comb == hash_to_g2(m1).mul(5)
+                      + hash_to_g2(m2).mul(7))
+        except Exception:  # noqa: BLE001 — trace/lowering failures too
+            ok = False
+        self._wire_rlc_ok[b] = ok
+        if not ok:
+            from ..utils.logging import default_logger
+
+            default_logger("engine").warn(
+                "engine", "wire_rlc_bucket_disabled", bucket=b)
+        return ok
+
+    def verify_wire_rlc(self, pubkey: PointG1, checks,
+                        dst: bytes = DEFAULT_DST_G2) -> np.ndarray | None:
+        """The wire-RLC tier core: per-check bool array when the span's
+        combined 2-pairing check lands (decode failures are per-item
+        False and excluded from the combination), or None to fall back
+        to the per-item wire graph — on an untrusted shape, a degenerate
+        combination, or a failed combined check (some signature is bad;
+        the fallback produces the exact verdicts). Spans above the
+        bucket chunk through it with one scalar vector, chunk sums added
+        on host, ONE pairing row at the end."""
+        n = len(checks)
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        if pubkey.is_infinity():
+            return None
+        b = self._good_bucket(n, check=self._check_wire_rlc,
+                              buckets=self._wire_rlc_buckets())
+        if b is None:
+            return None
+        cs = batch_verify.rlc_scalars(n)
+        ok_mask = np.zeros(n, dtype=bool)
+        s_acc = m_acc = None
+        for lo in range(0, n, b):
+            hi = min(lo + b, n)
+            got = self._combine_wire_chunk(checks[lo:hi], cs[lo:hi], b, dst)
+            if got is None:
+                return None
+            ok_chunk, s_chunk, m_chunk = got
+            ok_mask[lo:hi] = ok_chunk
+            if s_chunk is not None:
+                s_acc = s_chunk if s_acc is None else s_acc + s_chunk
+                m_acc = m_chunk if m_acc is None else m_acc + m_chunk
+        if s_acc is None:
+            return ok_mask  # nothing decodable: every check already False
+        if s_acc.is_infinity() or m_acc.is_infinity():
+            return None
+        if bool(self.verify_bls([(pubkey, s_acc, m_acc)])[0]):
+            return ok_mask
+        return None
+
+    def verify_beacons_wire_rlc(self, pubkey: PointG1, beacons,
+                                dst: bytes = DEFAULT_DST_G2
+                                ) -> np.ndarray | None:
+        """A span of beacons through the wire-RLC tier: per-beacon bool
+        array, or None to fall back (crypto/batch.py then re-dispatches
+        under the plain device tier)."""
+        from ..chain import beacon as chain_beacon
+
+        checks, spans = [], []
+        for bcn in beacons:
+            start = len(checks)
+            checks.append((chain_beacon.message(bcn.round, bcn.previous_sig),
+                           bcn.signature))
+            if bcn.is_v2():
+                checks.append((chain_beacon.message_v2(bcn.round),
+                               bcn.signature_v2))
+            spans.append((start, len(checks) - start))
+        flat = self.verify_wire_rlc(pubkey, checks, dst)
+        if flat is None:
+            return None
+        return np.array([bool(flat[s:s + c].all()) for s, c in spans])
 
     def verify_sigs(self, pubkey: PointG1, pairs,
                     dst: bytes = DEFAULT_DST_G2) -> list[bool]:
@@ -1249,6 +1478,7 @@ class BatchedEngine:
             oks = self.verify_partials(pub_poly, msg, partials, dst)
             return oks, self._recover_verified(pub_poly, msg, partials, oks,
                                                t, n, dst)
+        _meter_rows(npart + 1)
         oks, rec = self._run_agg(pub_poly, msg, partials, t, n, dst,
                                  b, b_msm, shares=shares)
         chosen = {s.index for s in shares}

@@ -84,6 +84,30 @@ def _msm_kernel(nbits: int, c_ref, pm2_ref, bits_ref, xs_ref, ys_ref,
     oinf_ref[:] = jnp.where(ainf, 1, 0)[None, :]
 
 
+def msm_g2_bl(xs_bl, ys_bl, inf2, bits_bl, nbits: int = 255):
+    """Batch-LAST Mosaic MSM entry — traced pieces only, so kernel
+    chains (ops/pallas_wire's wire-RLC combine) can feed it directly
+    without a host round-trip or an XLA transpose between kernels.
+
+    xs_bl/ys_bl: (2, NLIMBS, b) affine mont limbs; inf2: (1, b) int32
+    mask (nonzero = excluded lane); bits_bl: (nbits, b) int32 MSB-first.
+    b must be a power of two (the cross-lane fold rolls). Returns affine
+    (x (2, NLIMBS), y (2, NLIMBS), inf ()) of Σ bits_i ⋅ P_i."""
+    b = xs_bl.shape[-1]
+    if b & (b - 1):
+        raise ValueError(f"msm_g2_bl needs power-of-two lanes, got {b}")
+    cbuf = jnp.asarray(bl.lane_buffer(b))
+    pm2 = jnp.asarray(PM2_FLAT)
+    shp = jax.ShapeDtypeStruct((2, NLIMBS, b), DTYPE)
+    inf_shp = jax.ShapeDtypeStruct((1, b), DTYPE)
+    ax, ay, ainf = _pallas(
+        functools.partial(_msm_kernel, nbits),
+        (shp, shp, inf_shp), "vsvvvv")(
+        cbuf, pm2, bits_bl, xs_bl, ys_bl, inf2)
+    # lane 0 holds the fold result
+    return ax[..., 0], ay[..., 0], ainf[0, 0] != 0
+
+
 @functools.partial(jax.jit, static_argnames=("nbits",))
 def msm_g2_pl(xs, ys, inf, bits, nbits: int = 255):
     """Σ bits_i ⋅ P_i over G2 on the Pallas path.
@@ -100,13 +124,4 @@ def msm_g2_pl(xs, ys, inf, bits, nbits: int = 255):
     ys_bl = jnp.moveaxis(jnp.asarray(ys), 0, -1)
     inf2 = jnp.asarray(inf).astype(jnp.int32)[None, :]        # (1, b)
     bits_bl = jnp.asarray(bits).T.astype(jnp.int32)           # (nbits, b)
-    cbuf = jnp.asarray(bl.lane_buffer(LANES))
-    pm2 = jnp.asarray(PM2_FLAT)
-    shp = jax.ShapeDtypeStruct((2, NLIMBS, LANES), DTYPE)
-    inf_shp = jax.ShapeDtypeStruct((1, LANES), DTYPE)
-    ax, ay, ainf = _pallas(
-        functools.partial(_msm_kernel, nbits),
-        (shp, shp, inf_shp), "vsvvvv")(
-        cbuf, pm2, bits_bl, xs_bl, ys_bl, inf2)
-    # lane 0 holds the fold result
-    return ax[..., 0], ay[..., 0], ainf[0, 0] != 0
+    return msm_g2_bl(xs_bl, ys_bl, inf2, bits_bl, nbits)

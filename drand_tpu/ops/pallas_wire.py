@@ -18,6 +18,12 @@ Kernel chain (per batch of B lanes):
     K_glue      BP combination + to-affine              [ψ, adds]
     K_sig       decompress + Scott subgroup + to-affine
     ... then miller/easy/pow/is_one from pallas_pairing.
+
+The wire-RLC tier (wire_rlc_pl) swaps the per-lane pairing tail for two
+batch-last lane-MSM kernels (pallas_msm.msm_g2_bl, 128-bit RLC scalar
+ladders + cross-lane fold) that collapse the bucket to (Σc·sig,
+Σc·H(m)) — the combined pair then runs ONE row of the ordinary pairing
+bucket, so an all-valid span costs 2 Miller loops end-to-end.
 """
 
 from __future__ import annotations
@@ -196,6 +202,46 @@ def _wire_verify_pl(pub_xp, pub_yp, u_pairs, sig_x, sign_mask, b: int):
     q = jnp.stack([sig_aff, msg_aff])         # (NP, 2, 2, 32, B)
     pair_ok = pp._verify_pl(xp, yp, q, npairs=2, b=b)
     return pair_ok & (sig_ok[0] != 0) & (minf[0] == 0)
+
+
+@functools.partial(jax.jit, static_argnames=("b",))
+def _wire_rlc_pl(u_pairs, sig_x, sign_mask, live_mask, bits, b: int):
+    """Wire-RLC combine fully on device: decompress + subgroup-check the
+    signatures, hash the messages, then collapse the bucket to
+    (Σc·sig, Σc·H(m)) with two batch-last Mosaic lane-MSMs sharing the
+    scalar bits (pallas_msm.msm_g2_bl — the recovery MSM kernel with a
+    128-bit ladder). Lanes that fail decode, hash to infinity, or are
+    padding are masked to infinity in BOTH MSMs so one bad encoding
+    cannot poison the combination; the combined pair then feeds the
+    ordinary KAT-gated pairing bucket (2 Miller pairs for the span)."""
+    from . import pallas_msm
+
+    sx, sy, sig_ok = _sig_pl(sig_x, sign_mask, b)
+    mx, my, minf = _hash_msgs_pl(u_pairs, b)
+    ok = (sig_ok[0] != 0) & (live_mask[0] != 0) & (minf[0] == 0)
+    dead = jnp.where(ok, 0, 1)[None, :]                       # (1, b)
+    s_x, s_y, s_inf = pallas_msm.msm_g2_bl(sx, sy, dead, bits, nbits=128)
+    m_x, m_y, m_inf = pallas_msm.msm_g2_bl(mx, my, dead, bits, nbits=128)
+    return ok, s_x, s_y, s_inf, m_x, m_y, m_inf
+
+
+def wire_rlc_pl(u_pairs_np, sig_x_np, sign_np, live_np, bits_np):
+    """Host entry for the wire-RLC combine: u_pairs_np (B, 2, 2, 32)
+    batch-leading (ops/h2c.msgs_to_u layout); sig_x_np (B, 2, 32);
+    sign_np/live_np (B,) bool; bits_np (B, 128) MSB-first int32 scalar
+    bits. Returns numpy (ok (B,), s_x (2, 32), s_y, s_inf (), m_x, m_y,
+    m_inf) — the same shapes as the XLA combine graph so the engine
+    consumes either interchangeably."""
+    b = u_pairs_np.shape[0]
+    u_bl = jnp.asarray(np.moveaxis(u_pairs_np, 0, -1))        # (2, 2, 32, B)
+    sig_bl = jnp.asarray(np.moveaxis(sig_x_np, 0, -1))        # (2, 32, B)
+    sign_mask = jnp.asarray(
+        np.broadcast_to(sign_np.astype(np.int32)[None, :], (8, b)))
+    live_mask = jnp.asarray(
+        np.broadcast_to(live_np.astype(np.int32)[None, :], (8, b)))
+    bits_bl = jnp.asarray(bits_np.T.astype(np.int32))         # (128, B)
+    out = _wire_rlc_pl(u_bl, sig_bl, sign_mask, live_mask, bits_bl, b)
+    return tuple(np.asarray(o) for o in out)
 
 
 def verify_wire_pl(pubkey_aff, u_pairs_np, sig_x_np, sign_np,

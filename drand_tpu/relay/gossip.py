@@ -34,8 +34,10 @@ Delta vs the reference's libp2p gossipsub, for operators:
 from __future__ import annotations
 
 import asyncio
+import functools
 import hashlib
 import os
+import socket
 
 import grpc
 import grpc.aio
@@ -63,12 +65,17 @@ EVICT_COOLOFF = 300.0      # seconds before a banned peer is redialed
 
 
 class _PeerState:
-    __slots__ = ("channel", "fails", "banned_until")
+    __slots__ = ("channel", "fails", "banned_until", "ban_key")
 
-    def __init__(self, channel):
+    def __init__(self, channel, ban_key: str = ""):
         self.channel = channel
         self.fails = 0
         self.banned_until = 0.0
+        # the peer host in _peer_ip's bare-IP form — the _ip_scores key
+        # for the egress ban cross-check, resolved ONCE at add_peer time
+        # (a DNS lookup in the per-message forward path would stall the
+        # event loop)
+        self.ban_key = ban_key
 
 
 class _IpScore:
@@ -88,6 +95,32 @@ def _peer_ip(grpc_peer: str) -> str:
         kind, _, rest = grpc_peer.partition(":")
         return rest.rsplit(":", 1)[0] if kind == "ipv4" else grpc_peer
     return grpc_peer
+
+
+@functools.lru_cache(maxsize=256)
+def _resolve_host(host: str) -> str:
+    """Configured-peer host -> the bare-IP form _peer_ip yields for the
+    same machine, so the egress ban cross-check in _live_channel keys
+    the SAME table entries the ingress scorer writes: IPv6 brackets
+    stripped, hostnames resolved (first A/AAAA record; called from
+    add_peer only — configuration time, never the per-message forward
+    path — and cached. Resolution failures fall back to the literal
+    host, which then simply never matches an IP-keyed ban, the pre-fix
+    behavior)."""
+    if host.startswith("[") and host.endswith("]"):
+        host = host[1:-1]
+    try:
+        import ipaddress
+
+        ipaddress.ip_address(host)
+        return host  # already a literal IP
+    except ValueError:
+        pass
+    try:
+        infos = socket.getaddrinfo(host, None)
+        return infos[0][4][0]
+    except (OSError, IndexError):
+        return host
 
 
 class GossipNode(Client):
@@ -136,7 +169,9 @@ class GossipNode(Client):
 
     def add_peer(self, addr: str) -> None:
         if addr not in self._peers:
-            self._peers[addr] = _PeerState(grpc.aio.insecure_channel(addr))
+            self._peers[addr] = _PeerState(
+                grpc.aio.insecure_channel(addr),
+                ban_key=_resolve_host(addr.rsplit(":", 1)[0]))
 
     # ---------------------------------------------------------- scoring
     def _ban_peer(self, addr: str, st: _PeerState, why: str) -> None:
@@ -158,8 +193,10 @@ class GossipNode(Client):
                 return None
             st.banned_until = 0.0
             self._l.info("gossip", "peer_redialed", peer=addr)
-        ip = addr.rsplit(":", 1)[0]
-        sc = self._ip_scores.get(ip)
+        # _ip_scores is keyed by ingress source IP: look up the peer's
+        # add_peer-time normalized host ('[::1]:port' / hostname peers
+        # must not silently never match)
+        sc = self._ip_scores.get(st.ban_key)
         if sc is not None and now < sc.banned_until:
             return None
         if st.channel is None:

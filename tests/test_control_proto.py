@@ -123,3 +123,65 @@ async def test_control_json_still_native(tmp_path):
     finally:
         await ch.close()
         await ctl.stop()
+
+
+@pytest.mark.asyncio
+async def test_follow_rejects_mismatched_info_hash(tmp_path, monkeypatch):
+    """ADVICE r5 high: a follow must validate the fetched chain info
+    against the operator-supplied info_hash (core/drand_control.go:822-
+    829) — a lying peer serving different chain info must abort instead
+    of getting its self-supplied key pinned. Covers the daemon core (the
+    native JSON path calls straight through) and the protobuf streaming
+    endpoint."""
+    from drand_tpu.chain.info import Info
+    from drand_tpu.core.daemon import DrandError
+    from drand_tpu.crypto.curves import PointG1
+
+    clock = FakeClock(1_700_000_000.0)
+    net = LocalNetwork()
+    _, d0 = make_daemon(0, net, clock, tmp_path)
+    lying_info = Info(public_key=PointG1.generator().mul(7), period=30,
+                      genesis_time=1_700_000_000, genesis_seed=b"s" * 32,
+                      group_hash=b"g" * 32)
+
+    async def fake_chain_info(peer):
+        return lying_info
+
+    monkeypatch.setattr(d0.client, "chain_info", fake_chain_info)
+
+    with pytest.raises(DrandError, match="hash mismatch"):
+        await d0.follow_chain(["evil.test:7000"], info_hash=b"\x00" * 32)
+
+    # the matching hash pins the chain and proceeds into the syncer
+    class FakeSyncer:
+        def __init__(self, *a, **k):
+            pass
+
+        async def follow(self, up_to, peers):
+            return True
+
+    import drand_tpu.chain.engine.sync as sync_mod
+
+    monkeypatch.setattr(sync_mod, "Syncer", FakeSyncer)
+    assert await d0.follow_chain(["peer.test:7000"],
+                                 info_hash=lying_info.hash())
+    # and with no hash supplied the legacy unpinned behavior remains
+    assert await d0.follow_chain(["peer.test:7000"])
+
+    # protobuf codec: StartFollowChain aborts FAILED_PRECONDITION
+    ctl = ControlServer(d0, 0)
+    await ctl.start()
+    ch = grpc.aio.insecure_channel(f"127.0.0.1:{ctl.port}")
+    try:
+        fn = ch.unary_stream("/drand.Control/StartFollowChain")
+        call = fn(pw.encode(pw.START_FOLLOW_REQUEST, {
+            "info_hash": (b"\x11" * 32).hex(),
+            "nodes": ["evil.test:7000"], "up_to": 0}))
+        with pytest.raises(grpc.aio.AioRpcError) as ei:
+            async for _ in call:
+                pass
+        assert ei.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+        assert "hash mismatch" in (ei.value.details() or "")
+    finally:
+        await ch.close()
+        await ctl.stop()

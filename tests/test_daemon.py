@@ -256,3 +256,50 @@ async def test_second_setup_rejected_unless_forced(tmp_path):
     assert new_groups[0].public_key.key() == group.public_key.key()
     for d in daemons:
         d.stop()
+
+
+@pytest.mark.asyncio
+async def test_force_preempts_follower_awaiting_group(tmp_path):
+    """ADVICE r5: a forced second init while a FOLLOWER setup is still
+    awaiting the leader's group packet must cancel that wait (no
+    SetupManager exists on the follower side) instead of raising 'the
+    DKG phase is already running' — no DKG is running yet."""
+    from drand_tpu.core.daemon import DrandError
+
+    clock = FakeClock()
+    net = LocalNetwork()
+    lead_addr, *_, d_lead = make_daemon(0, net, clock, tmp_path)
+    _, *_, d_fol = make_daemon(1, net, clock, tmp_path)
+
+    # leader collects 3 participants (never completes) so the follower's
+    # signal is accepted and it parks awaiting the group push
+    lead_task = asyncio.ensure_future(
+        d_lead.init_dkg_leader(3, 2, PERIOD, SECRET, timeout=30))
+    await asyncio.sleep(0.05)
+    first = asyncio.ensure_future(
+        d_fol.init_dkg_follower(lead_addr, SECRET, timeout=30))
+    await asyncio.sleep(0.05)
+    assert d_fol._group_packet is not None
+    assert not d_fol._group_packet.done()
+    assert d_fol._setup_mgr is None  # follower setups have no manager
+
+    # un-forced second init is still rejected
+    with pytest.raises(DrandError, match="already in progress"):
+        await d_fol.init_dkg_follower(lead_addr, SECRET, timeout=5)
+    assert not first.done()
+
+    # forced second init preempts the parked follower: the first init
+    # unwinds via the cancelled group-packet future, the second owns the
+    # setup slot and parks awaiting a (new) group push
+    second = asyncio.ensure_future(
+        d_fol.init_dkg_follower(lead_addr, SECRET, timeout=30, force=True))
+    with pytest.raises(asyncio.CancelledError):
+        await first
+    await asyncio.sleep(0.05)
+    assert not second.done()
+    assert d_fol._group_packet is not None
+    assert not d_fol._group_packet.done()
+
+    for t in (second, lead_task):
+        t.cancel()
+    await asyncio.gather(second, lead_task, return_exceptions=True)

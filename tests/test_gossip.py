@@ -166,3 +166,46 @@ async def test_dead_peer_evicted_after_forward_failures():
         assert st.channel is None
     finally:
         await a.stop()
+
+
+@pytest.mark.asyncio
+async def test_ip_ban_cross_check_ipv6_and_hostname(monkeypatch):
+    """ADVICE r5: the egress skip of ingress-banned sources must key
+    _ip_scores the way _peer_ip writes it — IPv6 peers configured as
+    '[::1]:port' and hostname-configured peers both have to match their
+    bare-IP ban entries (the raw rsplit host never did)."""
+    import drand_tpu.relay.gossip as gmod
+
+    mock = MockBeaconServer(nrounds=2)
+    clock = FakeClock(start=mock.chain_info.genesis_time + 1000)
+    node = GossipNode(mock.chain_info, clock=clock)
+
+    def ban(ip):
+        sc = gmod._IpScore()
+        sc.banned_until = clock.now() + 100
+        node._ip_scores[ip] = sc
+
+    gmod._resolve_host.cache_clear()
+    try:
+        # IPv6: configured with brackets, ingress table keyed bare
+        node.add_peer("[::1]:9999")
+        ban("::1")
+        st = node._peers["[::1]:9999"]
+        assert node._live_channel("[::1]:9999", st) is None
+
+        # hostname peer resolving to a banned A record (stubbed DNS)
+        monkeypatch.setattr(
+            gmod.socket, "getaddrinfo",
+            lambda host, port, *a, **k: [(2, 1, 6, "", ("192.0.2.7", 0))])
+        node.add_peer("flooder.example:9000")
+        ban("192.0.2.7")
+        st2 = node._peers["flooder.example:9000"]
+        assert node._live_channel("flooder.example:9000", st2) is None
+
+        # an unbanned literal-IP peer still yields a channel
+        node.add_peer("10.0.0.5:9000")
+        st3 = node._peers["10.0.0.5:9000"]
+        assert node._live_channel("10.0.0.5:9000", st3) is not None
+    finally:
+        gmod._resolve_host.cache_clear()
+        await node.stop()

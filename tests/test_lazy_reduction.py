@@ -3,7 +3,9 @@
 The lazy path accumulates unreduced product convolutions and REDCs once
 per output coefficient (f2_mul 3->2, f6_mul 18->6, f12_mul 54->12
 REDCs). Its soundness rests on static per-site bounds (limb < 2^31
-everywhere, redc input < 2^30 limbs / ~2^778.5 value with wrap_passes=6)
+everywhere, redc input < 2^30 limbs / bl.REDC_VALUE_CEILING ~2^778.59
+value with wrap_passes=6 — statically re-verified at import by
+bl._redc_wrap_converges)
 — the probes here are the ones the round-3 reduce_light bug taught us:
 content-varied batches, CHAINED non-canonical values, and max-limb
 adversarial inputs, all against the host tower (crypto/fields).
@@ -129,7 +131,11 @@ def test_lazy_max_limb_adversarial():
 
 
 def test_redc_magnitude_ceiling():
-    """redc stays exact through the documented 2^778.5 value ceiling."""
+    """redc stays exact through the authoritative REDC_VALUE_CEILING
+    (~2^778.59 — the Z-site worst case the profiles are built for),
+    probing random values at and just under the full ceiling width."""
+    assert bl.REDC_VALUE_CEILING > 1 << 778  # the old figures undershot
+    assert bl._redc_wrap_converges(bl.REDC_VALUE_CEILING, wrap_passes=6)
     for vbits in (769, 774, 778):
         for _ in range(10):
             lim = np.asarray(
@@ -139,6 +145,18 @@ def test_redc_magnitude_ceiling():
             val = _x.limbs_to_int(lim)
             got = bl.unpack_fp(np.asarray(bl.redc(t)))[0]
             assert got == val * RINV % P * RINV % P, vbits
+    # the exact ceiling value itself (greedy top-down limb decomposition)
+    rem = bl.REDC_VALUE_CEILING
+    lims = [0] * 66
+    for k in range(65, -1, -1):
+        lims[k] = min((1 << 24) - 1, rem >> (12 * k))
+        rem -= lims[k] << (12 * k)
+    lim = np.asarray(lims, np.int32)
+    val = _x.limbs_to_int(lim)
+    assert val == bl.REDC_VALUE_CEILING
+    t = jnp.asarray(np.stack([lim, lim], axis=-1))
+    got = bl.unpack_fp(np.asarray(bl.redc(t)))[0]
+    assert got == val * RINV % P * RINV % P
 
 
 def test_cyclotomic_sqr_lazy_matches_host():

@@ -1,0 +1,259 @@
+"""Wire-graph RLC catch-up + endomorphism-Pippenger host MSM (ISSUE 5).
+
+The acceptance criteria pinned here:
+
+- an all-valid catch-up span through the device wire_rlc tier costs
+  exactly ONE pairing-graph row = 2 Miller pairs (was 2N), proven by the
+  ops/engine.py device pairing-row meter;
+- a KAT-gate failure (or a bad signature) falls back to the per-item
+  wire graph with exact verdicts — false-reject-only by construction;
+- a one-bad-item host span resolves through the batched 4-pairing
+  bisection (pairing.pairing_check_groups) with bool arrays
+  bit-identical to the per-item loop;
+- the ψ-endomorphism-split Pippenger MSM is value-identical to the
+  reference windowed MSM, including the split edge scalars 0, 1 and
+  2^128-1;
+- DRAND_TPU_BATCH_VERIFY=0 disables the wire_rlc tier like every other
+  RLC path.
+
+Kept late-alphabet on purpose: the wire graphs are compile-heavy and the
+tier-1 chunking note in ROADMAP wants such suites at the tail.
+"""
+
+import numpy as np
+import pytest
+from conftest import sample_count as _sample_count
+
+from drand_tpu import metrics
+from drand_tpu.chain.beacon import Beacon, message
+from drand_tpu.crypto import batch, batch_verify, bls
+from drand_tpu.crypto import pairing as hpairing
+from drand_tpu.crypto.curves import PointG1, PointG2
+
+
+@pytest.fixture(scope="module")
+def keys():
+    sk, pub = bls.keygen(seed=b"wire-rlc-test")
+    return sk, pub
+
+
+def _make_chain(sk: int, nrounds: int) -> list[Beacon]:
+    prev, out = b"\x42" * 32, []
+    for rnd in range(1, nrounds + 1):
+        sig = bls.sign(sk, message(rnd, prev))
+        out.append(Beacon(round=rnd, previous_sig=prev, signature=sig))
+        prev = sig
+    return out
+
+
+def _oracle(pub, beacons):
+    from drand_tpu.chain import beacon as chain_beacon
+
+    return [chain_beacon.verify_beacon(pub, b) for b in beacons]
+
+
+# ---------------------------------------------------------------------------
+# Host: ψ-split Pippenger MSM vs the windowed reference
+# ---------------------------------------------------------------------------
+
+class TestHostMSM:
+    def test_pippenger_endo_matches_window_g2(self):
+        import random
+
+        rng = random.Random(7)
+        g2 = PointG2.generator()
+        # edge scalars through the ψ split: 0 (drops out), 1 (rem-only),
+        # 2^128-1 (maximal q), M and M±1 (split boundary)
+        M = batch_verify._ENDO_M
+        pts = [g2.mul(k + 2) for k in range(8)]
+        scs = [0, 1, (1 << 128) - 1, M, M - 1, M + 1,
+               rng.randrange(1 << 128), rng.randrange(1 << 128)]
+        assert batch_verify.msm(pts, scs) == batch_verify.msm_window(pts, scs)
+        # a span large enough for the bucket branch
+        pts = [g2.mul(rng.randrange(1, 1 << 60)) for _ in range(40)]
+        scs = [rng.randrange(1 << 128) for _ in range(40)]
+        assert batch_verify.msm(pts, scs) == batch_verify.msm_window(pts, scs)
+        # all-zero scalars and infinity points degrade gracefully
+        assert batch_verify.msm(pts[:3], [0, 0, 0]).is_infinity()
+        got = batch_verify.msm([PointG2.infinity(), g2], [5, 3])
+        assert got == g2.mul(3)
+
+    def test_pippenger_matches_window_g1(self):
+        import random
+
+        rng = random.Random(11)
+        g1 = PointG1.generator()
+        pts = [g1.mul(rng.randrange(1, 1 << 60)) for _ in range(20)]
+        scs = [rng.randrange(1 << 128) for _ in range(20)]
+        assert batch_verify.msm(pts, scs) == batch_verify.msm_window(pts, scs)
+        assert batch_verify.msm_pippenger(pts, scs) == \
+            batch_verify.msm_window(pts, scs)
+
+    def test_endo_split_reconstructs_scalar(self):
+        g2 = PointG2.generator()
+        for c in (0, 1, (1 << 128) - 1, batch_verify._ENDO_M, 12345):
+            p = g2.mul(9)
+            pts, scs = batch_verify._endo_split_g2([p], [c])
+            acc = PointG2.infinity()
+            for q, s in zip(pts, scs):
+                assert s.bit_length() <= batch_verify._ENDO_Q_BITS
+                acc = acc + q.mul(s)
+            assert acc == p.mul(c)
+
+
+# ---------------------------------------------------------------------------
+# Host: batched 4-pairing bisection
+# ---------------------------------------------------------------------------
+
+class TestBatchedBisection:
+    def test_one_bad_item_grouped_dispatches(self, keys):
+        """9-beacon span, one bad signature: root check fails, then each
+        bisection level decides BOTH halves with one grouped 4-pairing
+        product check. Exact trace: root(2 pairs) + group{0-3, 4-8}(4)
+        + group{4-5, 6-8}(4) + leaf(4) + leaf(5) = 5 product-check
+        invocations / 14 Miller pairs — the sequential bisection paid 7
+        invocations for the same span."""
+        sk, pub = keys
+        beacons = _make_chain(sk, 9)
+        beacons[4].signature = beacons[3].signature
+        c0, p0 = hpairing.N_PRODUCT_CHECKS, hpairing.N_MILLER_PAIRS
+        got = batch_verify.verify_beacons_rlc(pub, beacons)
+        checks = hpairing.N_PRODUCT_CHECKS - c0
+        pairs = hpairing.N_MILLER_PAIRS - p0
+        oracle = _oracle(pub, beacons)
+        assert list(got) == oracle == [True] * 4 + [False] + [True] * 4
+        assert checks == 5
+        assert pairs == 14
+
+    def test_two_bad_items_still_bit_identical(self, keys):
+        sk, pub = keys
+        beacons = _make_chain(sk, 12)
+        beacons[2].signature = beacons[1].signature
+        beacons[9].signature = b"\x00" * 96  # malformed: per-item reject
+        got = batch_verify.verify_beacons_rlc(pub, beacons)
+        assert list(got) == _oracle(pub, beacons)
+        assert list(got) == [True, True, False] + [True] * 6 + [False,
+                                                                True, True]
+
+    def test_grouped_pairing_check_primitive(self, keys):
+        sk, pub = keys
+        from drand_tpu.crypto.hash_to_curve import hash_to_g2
+
+        m1, m2 = b"wrlc-a", b"wrlc-b"
+        s1 = PointG2.from_bytes(bls.sign(sk, m1))
+        s2 = PointG2.from_bytes(bls.sign(sk, m2))
+        neg = -PointG1.generator()
+        c0, p0 = hpairing.N_PRODUCT_CHECKS, hpairing.N_MILLER_PAIRS
+        oks = hpairing.pairing_check_groups([
+            [(neg, s1), (pub, hash_to_g2(m1))],
+            [(neg, s2), (pub, hash_to_g2(m2))],
+            [(neg, s1), (pub, hash_to_g2(m2))],   # mismatched: False
+            [],                                   # vacuous: True
+        ])
+        assert oks == [True, True, False, True]
+        assert hpairing.N_PRODUCT_CHECKS - c0 == 1
+        assert hpairing.N_MILLER_PAIRS - p0 == 6
+
+
+# ---------------------------------------------------------------------------
+# Device wire-RLC tier (CPU backend in the suite; compile-heavy)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.device
+class TestWireRLC:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        from drand_tpu.ops.engine import BatchedEngine
+
+        eng = BatchedEngine(buckets=(4,), wire_prep=True)
+        eng.rlc_min = 2
+        return eng
+
+    def test_all_valid_span_two_miller_pairs(self, engine, keys):
+        """THE acceptance criterion: an all-valid span through wire_rlc
+        dispatches exactly one pairing row = 2 Miller pairs (was 2N),
+        even when the span chunks over multiple combine buckets."""
+        from drand_tpu.ops import engine as eng_mod
+
+        sk, pub = keys
+        beacons = _make_chain(sk, 6)  # 6 checks over bucket 4: 2 chunks
+        got = engine.verify_beacons_wire_rlc(pub, beacons)
+        assert got is not None and got.all() and len(got) == 6
+        assert engine._wire_rlc_ok.get(4) is True
+        # warm: second span pays exactly one 2-pair product check
+        c0, p0 = eng_mod.N_PRODUCT_CHECKS, eng_mod.N_MILLER_PAIRS
+        got = engine.verify_beacons_wire_rlc(pub, beacons)
+        assert got is not None and got.all()
+        assert eng_mod.N_PRODUCT_CHECKS - c0 == 1
+        assert eng_mod.N_MILLER_PAIRS - p0 == 2
+
+    def test_malformed_lane_excluded_not_poisoning(self, engine, keys):
+        """A malformed signature encoding is a per-item False and is
+        masked out of the device combination — the rest of the span
+        still verifies as one 2-pair row."""
+        from drand_tpu.ops import engine as eng_mod
+
+        sk, pub = keys
+        beacons = _make_chain(sk, 6)
+        beacons[3].signature = b"\x00" * 96
+        c0, p0 = eng_mod.N_PRODUCT_CHECKS, eng_mod.N_MILLER_PAIRS
+        got = engine.verify_beacons_wire_rlc(pub, beacons)
+        assert got is not None
+        assert list(got) == [True, True, True, False, True, True]
+        assert eng_mod.N_MILLER_PAIRS - p0 == 2
+
+    def test_bad_signature_false_reject_only_fallback(self, engine, keys):
+        """A decodable-but-wrong signature fails the combined check: the
+        tier returns None (false-reject-only) and the cascade lands on
+        the per-item wire graph with exact verdicts."""
+        sk, pub = keys
+        beacons = _make_chain(sk, 4)
+        beacons[2].signature = beacons[1].signature
+        assert engine.verify_beacons_wire_rlc(pub, beacons) is None
+        got = engine.verify_beacons(pub, beacons)
+        assert list(got) == [True, True, False, True]
+
+    def test_kat_gate_failure_forces_wire_fallback(self, engine, keys,
+                                                   monkeypatch):
+        """A combine graph that fails its KAT is disabled: the tier
+        reports None and verify_beacons still answers exactly via the
+        per-item wire graph."""
+        sk, pub = keys
+        beacons = _make_chain(sk, 4)
+
+        def broken(*a, **k):
+            raise RuntimeError("wire-rlc miscompile probe")
+
+        monkeypatch.setattr(engine, "_wire_rlc_ok", {})
+        monkeypatch.setattr(engine, "_wire_rlc_jit", broken)
+        assert engine.verify_beacons_wire_rlc(pub, beacons) is None
+        assert engine._wire_rlc_ok.get(4) is False  # gate latched
+        got = engine.verify_beacons(pub, beacons)
+        assert got.all() and len(got) == 4
+
+    def test_escape_hatch_disables_wire_rlc(self, engine, monkeypatch):
+        monkeypatch.setenv("DRAND_TPU_BATCH_VERIFY", "0")
+        assert engine.wire_rlc_active(64) is False
+        monkeypatch.delenv("DRAND_TPU_BATCH_VERIFY", raising=False)
+        assert engine.wire_rlc_active(64) is True
+        assert engine.wire_rlc_active(1) is False  # under the floor
+
+    def test_dispatch_times_wire_rlc_path(self, engine, keys, monkeypatch):
+        """crypto/batch.py dispatches the tier under its own
+        engine_op_seconds{path="wire_rlc"} label (check_metrics lints the
+        label into the documented set)."""
+        sk, pub = keys
+        beacons = _make_chain(sk, 4)
+        monkeypatch.delenv("DRAND_TPU_BATCH_VERIFY", raising=False)
+        old = (batch._MODE, batch._MIN_BATCH, batch._ENGINE)
+        batch.configure("device", engine=engine)
+        try:
+            h0 = _sample_count(metrics.REGISTRY, "engine_op_seconds",
+                               op="verify_beacons", path="wire_rlc")
+            out = batch.verify_beacons(pub, beacons)
+            assert out.all() and len(out) == 4
+            assert _sample_count(metrics.REGISTRY, "engine_op_seconds",
+                                 op="verify_beacons",
+                                 path="wire_rlc") == h0 + 1
+        finally:
+            batch._MODE, batch._MIN_BATCH, batch._ENGINE = old

@@ -25,7 +25,10 @@ _METRIC_TYPES = {"Counter", "Gauge", "Histogram", "Summary", "Info"}
 
 # engine_op_seconds base path labels (crypto/batch.py _timed); the
 # _error/_invalid suffixes are appended dynamically on failure paths.
-KNOWN_ENGINE_PATHS = {"host", "device", "host_rlc"}
+# "wire_rlc" is the device wire-pipeline RLC tier (ops/engine.py
+# verify_wire_rlc: device hash-to-curve + in-graph lane-MSM, 2 Miller
+# pairs per catch-up span).
+KNOWN_ENGINE_PATHS = {"host", "device", "host_rlc", "wire_rlc"}
 # known label VALUES per labelled counter whose cardinality is a fixed
 # enum (new values need a deliberate catalogue update here + README)
 KNOWN_LABEL_VALUES = {"hash_to_g2_cache_requests": {"result": {"hit",
