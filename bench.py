@@ -28,10 +28,10 @@ Environment knobs:
     BENCH_MIN_SECONDS  minimum timed window per trial (default 5.0)
     BENCH_TRIALS       trials per config (default 2; best wins)
     BENCH_CONFIGS      comma list to run: any of
-                       msm,rlc,e2e,catchup,recover,deal,replay,headline
-                       (default: all; msm and rlc are host-only and run
-                       FIRST, before backend init, so they report even
-                       with the TPU tunnel down)
+                       msm,rlc,obs,e2e,catchup,recover,deal,replay,
+                       headline (default: all; msm, rlc and obs are
+                       host-only and run FIRST, before backend init, so
+                       they report even with the TPU tunnel down)
     DRAND_TPU_CONV     tree|kara|unroll — limb conv strategy (A/B)
     DRAND_TPU_LAZY     1|0 — lazy Fp2/6/12 reduction (A/B)
     DRAND_TPU_PAIRFOLD 1|0 — paired-line Miller fold (A/B)
@@ -427,6 +427,62 @@ def bench_verify_rlc(trials):
             "vs_baseline": None}
 
 
+def bench_obs_overhead(trials):
+    """Observability overhead A/B around a host verify span (ISSUE 6):
+    the same 32-beacon per-item verification loop run bare vs fully
+    instrumented the way the syncer's hot path is — a round-activated
+    trace context + one span per beacon + an engine_op_seconds
+    observation per beacon (a deliberately DENSER instrumentation than
+    production, which spans per chunk, so this bounds the real cost
+    from above). Pure host crypto, runs before backend init — the
+    "observability is cheap" claim stays provable with the tunnel
+    down."""
+    from drand_tpu.chain import beacon as chain_beacon
+    from drand_tpu.chain.beacon import Beacon, message
+    from drand_tpu.crypto import bls
+    from drand_tpu.crypto.batch import _timed
+    from drand_tpu.obs.trace import TRACER
+
+    span = 32
+    sk, pub = bls.keygen(seed=b"bench-obs")
+    prev, beacons = b"\x51" * 32, []
+    for rnd in range(1, span + 1):
+        sig = bls.sign(sk, message(rnd, prev))  # warms the h2c memo too
+        beacons.append(Beacon(round=rnd, previous_sig=prev, signature=sig))
+        prev = sig
+
+    def verify_all():
+        for b in beacons:
+            if not chain_beacon.verify_beacon(pub, b):
+                raise RuntimeError("verification failed")
+
+    def timed_bare():
+        t0 = time.perf_counter()
+        verify_all()
+        return time.perf_counter() - t0
+
+    def timed_instrumented():
+        t0 = time.perf_counter()
+        for b in beacons:
+            with TRACER.activate(round_no=b.round, chain=b"bench-obs",
+                                 retain=False), \
+                    TRACER.span("sync_verify", chunk=1, peer="bench"), \
+                    _timed("verify_beacons", "host", 1):
+                if not chain_beacon.verify_beacon(pub, b):
+                    raise RuntimeError("verification failed")
+        return time.perf_counter() - t0
+
+    trials = min(trials, 3)
+    dt_bare = best_of(trials, timed_bare)
+    dt_obs = best_of(trials, timed_instrumented)
+    overhead_pct = (dt_obs - dt_bare) / dt_bare * 100.0
+    return {"metric": "obs_overhead", "value": round(overhead_pct, 2),
+            "unit": "%", "span": span,
+            "bare_seconds": round(dt_bare, 4),
+            "instrumented_seconds": round(dt_obs, 4),
+            "spans_per_pass": span, "vs_baseline": None}
+
+
 def bench_msm_pippenger(trials):
     """Host MSM strategy A/B on a 64-point G2 span with 128-bit RLC
     scalars: the ψ-endomorphism-split Pippenger (crypto/batch_verify.msm
@@ -608,7 +664,7 @@ def main() -> None:
     t_start = time.perf_counter()
     which = os.environ.get(
         "BENCH_CONFIGS",
-        "msm,rlc,e2e,catchup,recover,deal,replay,headline").split(",")
+        "msm,rlc,obs,e2e,catchup,recover,deal,replay,headline").split(",")
 
     # --- outage-proofing (round-3 lesson: the official record must never
     # be an unparseable traceback). Two layers:
@@ -687,6 +743,16 @@ def main() -> None:
 
             log(traceback.format_exc())
             diag("aux_config_failed", config="rlc",
+                 error=f"{type(e).__name__}: {e}")
+    if "obs" in which:
+        log("== tracer+metrics overhead around a host verify span ==")
+        try:
+            emit(bench_obs_overhead(trials))
+        except Exception as e:  # noqa: BLE001 — best-effort aux config
+            import traceback
+
+            log(traceback.format_exc())
+            diag("aux_config_failed", config="obs",
                  error=f"{type(e).__name__}: {e}")
 
     from drand_tpu.utils.backend import BackendUnavailable, init_backend

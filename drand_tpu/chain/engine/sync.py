@@ -7,6 +7,7 @@ from __future__ import annotations
 import asyncio
 import os
 import random
+import time as _time
 from typing import AsyncIterator
 
 from ...crypto import batch
@@ -92,6 +93,13 @@ class Syncer:
                 self._l.debug("syncer", "already_following")
                 return False
             self._following = True
+        # catch-up progress surface (obs/health): rounds/sec + ETA per
+        # verified chunk, so a node syncing a year-old chain is
+        # observable instead of silent; zeroed when the follow ends
+        from ...obs.health import HEALTH
+
+        self._progress_t0 = _time.perf_counter()
+        self._progress_done = 0
         try:
             order = list(peers)
             random.shuffle(order)
@@ -102,6 +110,18 @@ class Syncer:
             return False
         finally:
             self._following = False
+            HEALTH.note_sync_progress(self._progress_done, 0.0, 0, up_to,
+                                      active=False)
+
+    def _note_progress(self, up_to: int, current_round: int,
+                       newly_stored: int) -> None:
+        from ...obs.health import HEALTH
+
+        self._progress_done += newly_stored
+        HEALTH.note_sync_progress(
+            self._progress_done,
+            _time.perf_counter() - self._progress_t0, current_round,
+            up_to)
 
     async def _try_node(self, up_to: int, peer) -> bool:
         try:
@@ -124,20 +144,26 @@ class Syncer:
                         TRACER.span("sync_verify", chunk=len(chunk),
                                     peer=_addr(peer)):
                     oks = batch.verify_beacons(self._info.public_key, chunk)
+                stored = 0
                 for b, ok in zip(chunk, oks):
                     if not ok:
                         self._l.warn("syncer", "invalid_beacon", peer=_addr(peer),
                                      round=b.round)
+                        self._note_progress(up_to, last.round, stored)
                         return False
                     try:
                         self._store.put(b)
                     except StoreError as e:
                         self._l.debug("syncer", "store_failed", err=str(e))
+                        self._note_progress(up_to, last.round, stored)
                         return False
                     last = b
+                    stored += 1
                     if up_to and last.round >= up_to:
                         self._l.debug("syncer", "finished", round=up_to)
+                        self._note_progress(up_to, last.round, stored)
                         return True
+                self._note_progress(up_to, last.round, stored)
         except TransportError as e:
             self._l.debug("syncer", "unable_to_sync", peer=_addr(peer), err=str(e))
             return False

@@ -259,7 +259,10 @@ class AppendStore(WrappedStore):
 class DiscrepancyStore(WrappedStore):
     """Observability decorator (chain/beacon/store.go:57-82): on every
     stored beacon, record how late it landed vs its scheduled round time
-    and the new chain tip, into the prometheus gauges."""
+    and the new chain tip — the reference gauges plus the chain-health
+    tier (lateness histogram, head/lag/missed, SLO window; obs/health)
+    — and hand the completed round's timeline to the OTLP exporter
+    (obs/export, flushed off the hot path)."""
 
     def __init__(self, inner: Store, group, clock):
         super().__init__(inner)
@@ -271,13 +274,21 @@ class DiscrepancyStore(WrappedStore):
         if b.round == 0:
             return
         from .. import metrics
+        from ..obs import export as obs_export
+        from ..obs.health import HEALTH
         from . import time_math
 
+        now = self._clock.now()
         expected = time_math.time_of_round(self._group.period,
                                            self._group.genesis_time, b.round)
-        metrics.BEACON_DISCREPANCY_LATENCY.set(
-            (self._clock.now() - expected) * 1000.0)
+        metrics.BEACON_DISCREPANCY_LATENCY.set((now - expected) * 1000.0)
         metrics.LAST_BEACON_ROUND.set(b.round)
+        HEALTH.note_round_stored(b.round, now - expected,
+                                 self._group.period)
+        HEALTH.observe_chain(now, self._group.period,
+                             self._group.genesis_time, b.round)
+        obs_export.note_round_complete(b.round,
+                                       self._group.get_genesis_seed())
 
 
 class CallbackStore(WrappedStore):

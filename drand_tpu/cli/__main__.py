@@ -13,8 +13,10 @@ through its localhost control port (cmd/drand-cli/control.go), exactly like
     python -m drand_tpu.cli get public --url http://host:port [--round R]
     python -m drand_tpu.cli get chain-info --url http://host:port
     python -m drand_tpu.cli show {share|group|chain-info|public|status} --control PORT
-    python -m drand_tpu.cli util {check|ping|trace} ...
+    python -m drand_tpu.cli util {check|ping|trace|engine} ...
     python -m drand_tpu.cli util trace --url http://host:port [--n K]
+    python -m drand_tpu.cli util trace --merge http://a:port http://b:port
+    python -m drand_tpu.cli util engine --url http://host:port
     python -m drand_tpu.cli stop --control PORT
 """
 
@@ -339,30 +341,120 @@ def _print_trace_timeline(data: dict) -> None:
         print()
 
 
+async def _fetch_json(base: str, path: str, **params) -> dict:
+    import aiohttp
+
+    base = base.rstrip("/")
+    async with aiohttp.ClientSession() as s:
+        async with s.get(f"{base}{path}", params=params or None) as r:
+            if r.status != 200:
+                raise SystemExit(f"{base}{path} -> HTTP {r.status}")
+            return await r.json()
+
+
+def _print_merged_timeline(merged: list[dict]) -> None:
+    """Render merge_round_timelines output: one interleaved timeline per
+    deterministic trace id, spans tagged with their source node."""
+    if not merged:
+        print("no shared round traces across the given nodes")
+        return
+    for rec in merged:
+        head = (f"round {rec.get('round')}  trace {rec.get('trace_id')}"
+                f"  nodes {','.join(rec.get('nodes', []))}")
+        if rec.get("dropped"):
+            head += f"  ({rec['dropped']} spans dropped)"
+        print(head)
+        spans = rec.get("spans", [])
+        t0 = spans[0]["start"] if spans else 0.0
+        for sp in spans:
+            off_ms = ((sp.get("start") or t0) - t0) * 1000.0
+            dur = sp.get("duration_ms") or 0.0
+            attrs = " ".join(f"{k}={v}" for k, v in
+                             (sp.get("attrs") or {}).items())
+            print(f"  +{off_ms:10.3f}ms  [{sp.get('node', '?'):<12}] "
+                  f"{sp['name']:<16} {dur:10.3f}ms  {attrs}")
+        print()
+
+
+def _print_engine_state(data: dict) -> None:
+    print(f"dispatch mode: {data.get('mode')}  "
+          f"min_batch={data.get('min_batch')}  "
+          f"engine_created={data.get('engine_created')}")
+    h2c = data.get("h2c_cache") or {}
+    print(f"h2c cache: {h2c.get('hits', 0)} hits / "
+          f"{h2c.get('misses', 0)} misses "
+          f"(size {h2c.get('size', 0)}/{h2c.get('maxsize', 0)})")
+    eng = data.get("engine")
+    if eng:
+        print(f"backend: {eng.get('backend')}  devices: "
+              f"{', '.join(eng.get('devices', [])) or '?'}")
+        print(f"buckets: verify={eng.get('buckets')} "
+              f"wire={eng.get('wire_buckets')} "
+              f"rlc_lanes={eng.get('rlc_lane_buckets')} "
+              f"wire_rlc={eng.get('wire_rlc_buckets')}")
+        for family, shapes in (eng.get("kat") or {}).items():
+            if not shapes:
+                continue
+            verdicts = "  ".join(
+                f"{shape}={'OK' if ok else 'DISABLED'}"
+                for shape, ok in shapes.items())
+            print(f"kat {family:<10} {verdicts}")
+    elif data.get("engine_error"):
+        print(f"engine introspection failed: {data['engine_error']}")
+    else:
+        print("device engine not created in this process "
+              "(host crypto only so far)")
+    ledger = data.get("fallback_ledger") or []
+    print(f"fallback ledger ({len(ledger)} entries, newest last):")
+    for e in ledger:
+        print(f"  round={e.get('round')} op={e.get('op')} "
+              f"path={e.get('path')} reason={e.get('reason')}")
+
+
 def cmd_util(args) -> None:
     if args.what == "trace":
-        # fetch + pretty-print the round timeline of a running node
-        # (the always-on /debug/trace/rounds surface)
-        if not args.url:
-            raise SystemExit("util trace requires --url http://host:port")
+        # fetch + pretty-print round timelines; --merge interleaves
+        # several nodes' rings into one timeline per deterministic
+        # trace id (the cross-node stitch the blake2b ids exist for)
+        urls = args.merge or ([args.url] if args.url else [])
+        if not urls:
+            raise SystemExit("util trace requires --url http://host:port "
+                             "(or --merge url1 url2 ...)")
 
         async def run_trace():
-            import aiohttp
+            payloads = await asyncio.gather(
+                *(_fetch_json(u, "/debug/trace/rounds", n=args.n)
+                  for u in urls))
+            if args.merge:
+                from ..obs.trace import merge_round_timelines
 
-            base = args.url.rstrip("/")
-            async with aiohttp.ClientSession() as s:
-                async with s.get(f"{base}/debug/trace/rounds",
-                                 params={"n": args.n}) as r:
-                    if r.status != 200:
-                        raise SystemExit(
-                            f"{base}/debug/trace/rounds -> HTTP {r.status}")
-                    data = await r.json()
+                merged = merge_round_timelines(
+                    list(zip(urls, payloads)))
+                if args.json:
+                    print(json.dumps({"rounds": merged}, indent=2))
+                else:
+                    _print_merged_timeline(merged)
+            elif args.json:
+                print(json.dumps(payloads[0], indent=2))
+            else:
+                _print_trace_timeline(payloads[0])
+
+        asyncio.run(run_trace())
+        return
+    if args.what == "engine":
+        # engine introspection of a running node (/debug/engine):
+        # KAT-gate status, fallback ledger, backend identity
+        if not args.url:
+            raise SystemExit("util engine requires --url http://host:port")
+
+        async def run_engine():
+            data = await _fetch_json(args.url, "/debug/engine")
             if args.json:
                 print(json.dumps(data, indent=2))
             else:
-                _print_trace_timeline(data)
+                _print_engine_state(data)
 
-        asyncio.run(run_trace())
+        asyncio.run(run_engine())
         return
     if args.what == "del-beacon":
         # offline rollback (reference cli.go:651 deleteBeaconCmd): daemon
@@ -697,18 +789,24 @@ def main(argv=None) -> None:
 
     u = sub.add_parser("util")
     u.add_argument("what", choices=["ping", "check", "del-beacon",
-                                    "self-sign", "reset", "trace"])
+                                    "self-sign", "reset", "trace",
+                                    "engine"])
     u.add_argument("--control", type=int, default=8888)
     u.add_argument("--address")
     u.add_argument("--folder")
     u.add_argument("--round", type=int, default=None)
     u.add_argument("--force", action="store_true",
                    help="confirm destructive util commands (reset)")
-    u.add_argument("--url", help="public HTTP base URL (trace)")
+    u.add_argument("--url", help="public HTTP base URL (trace/engine)")
+    u.add_argument("--merge", nargs="+", metavar="URL",
+                   help="trace: fetch several nodes' rings and "
+                        "interleave spans sharing a trace id into one "
+                        "cross-node timeline")
     u.add_argument("--n", type=int, default=8,
                    help="round timelines to fetch (trace)")
     u.add_argument("--json", action="store_true",
-                   help="raw JSON instead of the pretty timeline (trace)")
+                   help="raw JSON instead of the pretty rendering "
+                        "(trace/engine)")
     u.set_defaults(fn=cmd_util)
 
     r = sub.add_parser("relay")

@@ -250,6 +250,11 @@ class Drand(ProtocolService):
     def start_beacon(self, catchup: bool = True) -> None:
         """Boot the beacon from persisted state (core/drand.go:220)."""
         group, share = self._require_loaded()
+        # a loaded group+share IS a completed DKG (readiness gate,
+        # obs/health — the restart twin of _adopt_dkg_output)
+        from ..obs.health import HEALTH
+
+        HEALTH.note_dkg_complete()
         self._make_handler(group, share)
         if catchup:
             asyncio.ensure_future(self.beacon.catchup())
@@ -412,6 +417,9 @@ class Drand(ProtocolService):
             self.store.save_share(self.share)
         self._make_handler(group, self.share)
         asyncio.ensure_future(self.beacon.start())
+        from ..obs.health import HEALTH
+
+        HEALTH.note_dkg_complete()
         self._l.info("dkg", "finished", qual=result.qual,
                      genesis=group.genesis_time)
         return group

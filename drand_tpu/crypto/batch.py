@@ -29,7 +29,9 @@ engine_op_seconds so the speedup shows up next to ``host`` and
 from __future__ import annotations
 
 import os
+import threading
 import time as _time
+from collections import deque
 
 import numpy as np
 
@@ -42,6 +44,35 @@ _MODE = os.environ.get("DRAND_TPU_ENGINE", "auto")
 _MIN_BATCH = int(os.environ.get("DRAND_TPU_MIN_BATCH", "8"))
 _ENGINE = None
 _FALLBACK_LOGGED = False
+
+# Bounded fallback ledger (ISSUE 6 engine introspection): the last N
+# times a dispatch left its preferred tier — device exceptions that fell
+# back to host AND wire_rlc combines that returned None (false-reject
+# fallback to the per-item graph). /debug/engine serves it so "why did
+# this hour's traffic run on host?" is answerable from a running node.
+FALLBACK_LEDGER_MAX = 32
+_FALLBACK_LEDGER: deque = deque(maxlen=FALLBACK_LEDGER_MAX)
+_LEDGER_LOCK = threading.Lock()
+
+
+def _ledger_note(op: str, path: str, reason: str) -> None:
+    from ..obs.trace import current_round
+
+    with _LEDGER_LOCK:
+        _FALLBACK_LEDGER.append({
+            "op": op, "path": path, "reason": reason[:300],
+            "round": current_round(), "time": _time.time()})
+
+
+def fallback_ledger() -> list[dict]:
+    """Newest-last copy of the bounded fallback ledger."""
+    with _LEDGER_LOCK:
+        return list(_FALLBACK_LEDGER)
+
+
+def reset_fallback_ledger() -> None:
+    with _LEDGER_LOCK:
+        _FALLBACK_LEDGER.clear()
 
 
 _RLC_KNOB_WARNED = False
@@ -88,6 +119,7 @@ def _note_fallback(op: str, err: Exception) -> None:
     from .. import metrics
 
     metrics.ENGINE_FALLBACKS.inc()
+    _ledger_note(op, "device", f"{type(err).__name__}: {err}")
     if not _FALLBACK_LOGGED:
         _FALLBACK_LOGGED = True
         from ..utils.logging import default_logger
@@ -112,6 +144,15 @@ def _note_dispatch(op: str) -> None:
     metrics.ENGINE_BATCHES.labels(op=op).inc()
 
 
+# (op, path, batch-bucket) device shapes whose FIRST successful
+# dispatch already happened — the first one carries the jit compile
+# (seconds to minutes cold) and is split into engine_compile_seconds so
+# steady-state engine_op_seconds percentiles stay alertable. Host paths
+# never compile; only device-side paths divert.
+_COMPILE_PATHS = ("device", "wire_rlc")
+_WARM_SHAPES: set[tuple[str, str, str]] = set()
+
+
 class _timed:
     """Observe engine_op_seconds{op,path,batch} around one dispatch —
     the per-op device-vs-host latency surface. Failed dispatches are
@@ -122,7 +163,14 @@ class _timed:
     convention (e.g. below-threshold recover) — land under
     ``<path>_invalid`` instead: an instant raise in the _error series
     would page operators alerting on wedged-device signals for a
-    routine degraded round."""
+    routine degraded round.
+
+    The first SUCCESSFUL dispatch of each device (op, path, batch)
+    shape observes ``engine_compile_seconds{op}`` instead — that sample
+    is dominated by XLA/Mosaic compile + KAT probes, and folding it
+    into engine_op_seconds would poison the steady-state p99 every
+    process restart. Failed first dispatches stay in the <path>_error
+    series (the shape is still cold for the retry)."""
 
     def __init__(self, op: str, path: str, n: int):
         self._labels = (op, path, n)
@@ -133,14 +181,21 @@ class _timed:
 
     def __exit__(self, exc_type, exc, tb):
         op, path, n = self._labels
+        dt = _time.perf_counter() - self._t0
+        from .. import metrics
+
+        bucket = metrics.batch_bucket(n)
         if exc_type is not None:
             path += ("_invalid" if issubclass(exc_type, ValueError)
                      else "_error")
-        from .. import metrics
-
+        elif path in _COMPILE_PATHS:
+            key = (op, path, bucket)
+            if key not in _WARM_SHAPES:
+                _WARM_SHAPES.add(key)
+                metrics.ENGINE_COMPILE_SECONDS.labels(op=op).observe(dt)
+                return False
         metrics.ENGINE_OP_SECONDS.labels(
-            op=op, path=path, batch=metrics.batch_bucket(n)).observe(
-            _time.perf_counter() - self._t0)
+            op=op, path=path, batch=bucket).observe(dt)
         return False
 
 
@@ -154,6 +209,10 @@ def configure(mode: str, min_batch: int | None = None, engine=None) -> None:
         _MIN_BATCH = min_batch
     if engine is not None:
         _ENGINE = engine
+        # a replacement engine owns no compiled executables: its first
+        # dispatch per shape pays the jit compile again and must land in
+        # engine_compile_seconds, not the steady-state series
+        _WARM_SHAPES.clear()
 
 
 def engine():
@@ -236,6 +295,11 @@ def verify_beacons(pubkey: PointG1, beacons,
                 # verdicts, under its own path label.
                 with _timed("verify_beacons", "wire_rlc", len(beacons)):
                     out = eng.verify_beacons_wire_rlc(pubkey, beacons, dst)
+                if out is None:
+                    _ledger_note(
+                        "verify_beacons", "wire_rlc",
+                        "combine rejected (failed combined check / "
+                        "untrusted shape) — per-item wire graph decides")
             if out is None:
                 with _timed("verify_beacons", "device", len(beacons)):
                     out = eng.verify_beacons(pubkey, beacons, dst,

@@ -23,6 +23,7 @@ import cProfile
 import gc
 import io
 import pstats
+import re
 import sys
 import tempfile
 import traceback
@@ -40,20 +41,56 @@ def add_debug_routes(app: web.Application) -> None:
 
 
 def add_trace_routes(app: web.Application) -> None:
-    app.add_routes([web.get("/debug/trace/rounds", _trace_rounds)])
+    """The always-on introspection surface: round timelines + engine
+    state (both are dict reads — no profiling cost to gate)."""
+    app.add_routes([
+        web.get("/debug/trace/rounds", _trace_rounds),
+        web.get("/debug/engine", _engine_state),
+    ])
 
 
 async def _trace_rounds(request: web.Request) -> web.Response:
     """The last n completed round timelines from the in-process tracer
-    ring — `drand util trace` pretty-prints this payload."""
+    ring — `drand util trace` pretty-prints this payload.
+
+    ``n`` is untrusted public input: only plain base-10 integers parse
+    (no floats, no '1e6', no '0x' — int() would take surprising forms
+    via whitespace/unicode digits), and the value clamps to
+    [1, ring size] so negative/zero/huge asks cannot error or
+    over-allocate."""
     from ..obs.trace import TRACER
 
-    try:
-        n = int(request.query.get("n", "8"))
-    except ValueError:
+    raw = request.query.get("n", "8").strip()
+    if not re.fullmatch(r"[+-]?[0-9]+", raw):
         return web.json_response({"error": "bad n"}, status=400)
-    n = max(1, min(n, TRACER.max_rounds))
+    n = max(1, min(int(raw), TRACER.max_rounds))
     return web.json_response({"rounds": TRACER.rounds(n)})
+
+
+async def _engine_state(request: web.Request) -> web.Response:
+    """Engine introspection (ISSUE 6): dispatch policy, the bounded
+    fallback ledger, h2c-LRU stats, and — when the device engine has
+    been created — backend/device identity plus every graph family's
+    per-bucket KAT-gate verdicts. Deliberately never CREATES the
+    engine: batch.engine() initializes the jax backend, which can hang
+    on a dead tunnel; this endpoint only reports what already exists."""
+    from ..crypto import batch
+    from ..crypto.hash_to_curve import h2c_cache_info
+
+    payload = {
+        "mode": batch._MODE,
+        "min_batch": batch._MIN_BATCH,
+        "engine_created": batch._ENGINE is not None,
+        "fallback_ledger": batch.fallback_ledger(),
+        "h2c_cache": h2c_cache_info(),
+        "warm_shapes": sorted("/".join(k) for k in batch._WARM_SHAPES),
+    }
+    if batch._ENGINE is not None:
+        try:
+            payload["engine"] = batch._ENGINE.introspect()
+        except Exception as e:  # noqa: BLE001 — introspection must not 500
+            payload["engine_error"] = repr(e)
+    return web.json_response(payload)
 
 
 _PROFILE_LOCK = asyncio.Lock()  # cProfile and the JAX tracer cannot nest

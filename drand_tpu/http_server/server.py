@@ -64,6 +64,8 @@ class PublicServer:
             web.get("/public/{round}", self._handle_round),
             web.get("/info", self._handle_info),
             web.get("/health", self._handle_health),
+            web.get("/healthz", self._handle_healthz),
+            web.get("/readyz", self._handle_readyz),
             web.get("/metrics", self._handle_metrics),
             web.get("/peer/{addr}/metrics", self._handle_peer_metrics),
         ])
@@ -219,12 +221,72 @@ class PublicServer:
             return web.json_response({"error": str(e)}, status=503)
         expected = time_math.current_round(
             int(self._clock.now()), info.period, info.genesis_time)
-        current = self._latest.round if self._latest is not None else 0
-        if current == 0:
-            try:
-                current = (await self._client.get(0)).round
-            except ClientError:
-                current = 0
+        current = await self._head_round()
         body = {"current": current, "expected": expected}
         status = 200 if current + 1 >= expected else 500
         return web.json_response(body, status=status)
+
+    async def _head_round(self) -> int:
+        """Best known chain head: the watch-loop tip, else one fetch."""
+        if self._latest is not None:
+            return self._latest.round
+        try:
+            return (await self._client.get(0)).round
+        except ClientError:
+            return 0
+
+    async def _chain_health(self):
+        """(snapshot, info) with the health gauges re-evaluated against
+        the wall clock — the pull half of obs/health: a fully stalled
+        chain (group lost threshold, peer died) stores nothing, so
+        probes and scrapes must drive head-lag and the missed-round
+        counter. Raises ClientError while there is no chain info yet
+        (pre-DKG / relay origin down)."""
+        from ..obs.health import HEALTH
+
+        info = await self._client.info()
+        head = await self._head_round()
+        HEALTH.observe_chain(self._clock.now(), info.period,
+                             info.genesis_time, head)
+        snap = HEALTH.snapshot()
+        snap["period"] = info.period
+        return snap, info
+
+    async def _handle_healthz(self, request: web.Request) -> web.Response:
+        """Chain-health SLO surface (ISSUE 6): head/lag/missed/SLO
+        snapshot; 200 while the head lags by at most
+        DRAND_TPU_READY_MAX_LAG rounds, 503 otherwise (and while no
+        chain info exists yet)."""
+        from ..obs.health import READY_MAX_LAG, HEALTH, is_ready
+
+        try:
+            snap, _ = await self._chain_health()
+        except ClientError as e:
+            body = HEALTH.snapshot()
+            body.update(status="no_chain", error=str(e))
+            return web.json_response(body, status=503)
+        ok = is_ready(snap)
+        snap["status"] = "ok" if ok else "lagging"
+        snap["max_lag"] = READY_MAX_LAG
+        return web.json_response(snap, status=200 if ok else 503)
+
+    async def _handle_readyz(self, request: web.Request) -> web.Response:
+        """Readiness: chain info servable (the DKG-complete signal at
+        this layer — a relay has no DKG, and a daemon cannot serve info
+        before its DKG finished) AND head-lag within bound. The
+        daemon-recorded dkg_complete flag rides along for operators."""
+        from ..obs.health import READY_MAX_LAG, is_ready
+
+        try:
+            snap, _ = await self._chain_health()
+        except ClientError as e:
+            return web.json_response(
+                {"ready": False, "reason": f"no chain info: {e}"},
+                status=503)
+        ready = is_ready(snap)
+        snap["ready"] = ready
+        snap["max_lag"] = READY_MAX_LAG
+        if not ready:
+            snap["reason"] = (f"head lag {snap['lag_rounds']} > "
+                              f"{READY_MAX_LAG} rounds")
+        return web.json_response(snap, status=200 if ready else 503)

@@ -39,6 +39,27 @@ Additions beyond the reference (the TPU engine + round tracing):
       catch-up span with no host hashing)
   hash_to_g2_cache_requests{result}    [private] hash-to-G2 memo
       hit/miss counters (crypto/hash_to_curve.py per-round keyed LRU)
+Chain-health / SLO set (obs/health.py, ISSUE 6 — fed by the
+DiscrepancyStore on every stored beacon and re-evaluated by /healthz):
+  beacon_round_lateness_seconds        [group]   actual emit time vs the
+      scheduled round boundary, per stored round
+  chain_head_round                     [group]   last stored round
+  chain_head_lag_rounds                [group]   expected round - head
+  beacon_rounds_missed_total           [group]   rounds whose whole
+      period passed with no stored beacon (counted once per round)
+  beacon_slo_late_fraction             [group]   sliding-window fraction
+      of rounds late by more than period/2
+  chain_sync_rounds_per_second         [group]   follow_chain catch-up
+      throughput (0 when no follow is running)
+  chain_sync_eta_seconds               [group]   follow_chain ETA to the
+      target round (-1 = unbounded follow, 0 = idle/done)
+Engine introspection (ISSUE 6):
+  engine_compile_seconds{op}           [private] FIRST dispatch of each
+      (op, path, batch-bucket) device shape — the jit compile +
+      first-run cost, split out so steady-state engine_op_seconds
+      percentiles stay clean (crypto/batch.py _timed)
+  otlp_export_rounds_total{sink}       [private] round traces exported
+      by the OTLP exporter, by sink (http|spool|dropped)
 
 Everything is exposed on /metrics (render() gathers all four registries
 — the reference's handler chains its gatherers the same way,
@@ -149,6 +170,57 @@ ENGINE_OP_SECONDS = Histogram(
     "Batched crypto op latency by path (device|host; failed dispatches "
     "land under <path>_error) and batch bucket",
     ["op", "path", "batch"], registry=REGISTRY, buckets=_LATENCY_BUCKETS)
+ENGINE_COMPILE_SECONDS = Histogram(
+    "engine_compile_seconds",
+    "First dispatch of each (op, batch-bucket) device shape — jit "
+    "compile + first run, split from steady-state engine_op_seconds",
+    ["op"], registry=REGISTRY,
+    buckets=(0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0))
+
+# ---- chain health / SLOs (obs/health.py) ----------------------------------
+# Lateness spans "on time" (ms after the boundary) to "a whole period
+# late"; the SLO threshold is period/2, so the buckets must resolve
+# fractions of typical periods (3-30 s).
+_LATENESS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 15.0,
+                     30.0, 60.0, 120.0)
+BEACON_LATENESS = Histogram(
+    "beacon_round_lateness_seconds",
+    "Actual beacon emit time minus the scheduled round boundary",
+    registry=GROUP_REGISTRY, buckets=_LATENESS_BUCKETS)
+CHAIN_HEAD_ROUND = Gauge(
+    "chain_head_round", "Last beacon round stored on this node's chain",
+    registry=GROUP_REGISTRY)
+CHAIN_HEAD_LAG = Gauge(
+    "chain_head_lag_rounds",
+    "Rounds between the wall-clock expected round and the stored head",
+    registry=GROUP_REGISTRY)
+MISSED_ROUNDS = Counter(
+    "beacon_rounds_missed_total",
+    "Rounds whose whole period elapsed with no beacon stored "
+    "(counted once per skipped round; a later catch-up does not uncount)",
+    registry=GROUP_REGISTRY)
+SLO_LATE_FRACTION = Gauge(
+    "beacon_slo_late_fraction",
+    "Fraction of the sliding round window emitted later than period/2 "
+    "after their boundary (the chain-health SLO)",
+    registry=GROUP_REGISTRY)
+SYNC_ROUNDS_PER_SEC = Gauge(
+    "chain_sync_rounds_per_second",
+    "follow_chain catch-up throughput over the current follow "
+    "(0 when idle)", registry=GROUP_REGISTRY)
+SYNC_ETA_SECONDS = Gauge(
+    "chain_sync_eta_seconds",
+    "Estimated seconds until follow_chain reaches its target round "
+    "(-1 for an unbounded follow, 0 when idle/done)",
+    registry=GROUP_REGISTRY)
+
+# ---- OTLP export (obs/export.py) ------------------------------------------
+OTLP_EXPORT_ROUNDS = Counter(
+    "otlp_export_rounds_total",
+    "Round traces handed to the OTLP exporter, by sink "
+    "(http = POSTed to the collector, spool = appended to the on-disk "
+    "NDJSON ring, dropped = both sinks failed)",
+    ["sink"], registry=REGISTRY)
 
 
 def batch_bucket(n: int) -> str:

@@ -1,14 +1,23 @@
-"""Observability: the round-lifecycle tracing subsystem (obs/trace.py).
+"""Observability: round-lifecycle tracing (obs/trace.py), chain-health
+state + SLOs (obs/health.py), and OTLP export of the span ring
+(obs/export.py).
 
 Import surface:
     from drand_tpu.obs import trace
     with trace.TRACER.activate(round_no=r, chain=seed):
         with trace.TRACER.span("collect", have=3):
             ...
+    from drand_tpu.obs.health import HEALTH
+    from drand_tpu.obs import export as obs_export
+
+``health`` and ``export`` are imported lazily by their call sites (the
+store decorator, the HTTP handlers) — importing ``drand_tpu.obs`` must
+stay as cheap as it was in PR 1.
 """
 
 from . import trace  # noqa: F401
 from .trace import (  # noqa: F401
+    merge_round_timelines,
     TRACEPARENT_HEADER,
     TRACER,
     Span,

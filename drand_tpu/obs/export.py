@@ -1,0 +1,274 @@
+"""OTLP export of the round-trace ring (ISSUE 6, Dapper-style
+completion of the PR-1 tracing layer).
+
+The Span model is already W3C-shaped (32-hex trace ids, 16-hex span
+ids), so serializing a ring record to OTLP/JSON ``resourceSpans`` is a
+pure reshaping — no OTel SDK needed (none in this image).
+
+Sinks, in order:
+
+- ``DRAND_TPU_OTLP_ENDPOINT``: POST one OTLP/JSON export request per
+  completed round to ``<endpoint>/v1/traces`` (or verbatim when the
+  URL already ends in ``/v1/traces``) — the standard OTLP/HTTP path a
+  collector expects.
+- ``DRAND_TPU_OTLP_SPOOL``: append one NDJSON line per round to a
+  bounded on-disk ring, so traces survive restarts and can be shipped
+  later (``read_spool`` parses them back). When the file exceeds
+  ``DRAND_TPU_OTLP_SPOOL_MAX`` bytes (default 4 MiB) it rotates to
+  ``<path>.1`` (previous ``.1`` dropped) — disk use is bounded at ~2x
+  the cap. The spool is ALSO the fallback when a configured endpoint
+  POST fails, so a collector outage loses nothing.
+
+With neither env var set the exporter is off — no surprise disk writes
+or sockets from library use.
+
+Flushing is per COMPLETED round and never on the hot path: the store
+decorator calls :func:`note_round_complete`, which defers the ring
+lookup + serialization + I/O with ``loop.call_soon`` (so the round's
+``store`` span has closed by the time we read the ring) and runs the
+POST in a background task. Outside an event loop it flushes inline —
+that only happens in synchronous tools and tests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import threading
+
+from .trace import TRACER, round_trace_id
+
+_SPAN_KIND_INTERNAL = 1
+
+
+def _attr(key: str, value) -> dict:
+    if isinstance(value, bool):
+        v = {"boolValue": value}
+    elif isinstance(value, int):
+        v = {"intValue": str(value)}  # OTLP/JSON carries int64 as string
+    elif isinstance(value, float):
+        v = {"doubleValue": value}
+    else:
+        v = {"stringValue": str(value)}
+    return {"key": key, "value": v}
+
+
+def _nanos(t: float | None) -> str:
+    return str(int((t or 0.0) * 1e9))
+
+
+def round_to_otlp(rec: dict, resource_attrs: dict | None = None) -> dict:
+    """One tracer ring record (``{"trace_id","round","spans",...}``) ->
+    one OTLP/JSON ExportTraceServiceRequest body."""
+    spans = []
+    for sp in rec.get("spans", ()):
+        attrs = [_attr(k, v) for k, v in (sp.get("attrs") or {}).items()]
+        if rec.get("round") is not None:
+            attrs.append(_attr("drand.round", rec["round"]))
+        spans.append({
+            "traceId": rec["trace_id"],
+            "spanId": sp["span_id"],
+            "parentSpanId": sp.get("parent_id") or "",
+            "name": sp["name"],
+            "kind": _SPAN_KIND_INTERNAL,
+            "startTimeUnixNano": _nanos(sp.get("start")),
+            "endTimeUnixNano": _nanos(sp.get("end")),
+            "attributes": attrs,
+            "status": {},
+        })
+    res_attrs = [_attr("service.name", "drand-tpu")]
+    for k, v in (resource_attrs or {}).items():
+        res_attrs.append(_attr(k, v))
+    return {"resourceSpans": [{
+        "resource": {"attributes": res_attrs},
+        "scopeSpans": [{
+            "scope": {"name": "drand_tpu.obs", "version": "1"},
+            "spans": spans,
+        }],
+    }]}
+
+
+def read_spool(path: str) -> list[dict]:
+    """Parse the NDJSON spool (current file plus the rotated ``.1`` when
+    present, oldest first) back into OTLP export dicts."""
+    out: list[dict] = []
+    for p in (path + ".1", path):
+        if not os.path.isfile(p):
+            continue
+        with open(p, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+    return out
+
+
+class OTLPExporter:
+    def __init__(self, endpoint: str | None = None,
+                 spool_path: str | None = None,
+                 max_spool_bytes: int = 4 << 20,
+                 resource_attrs: dict | None = None,
+                 timeout: float = 5.0):
+        self.endpoint = endpoint
+        if endpoint and not endpoint.rstrip("/").endswith("/v1/traces"):
+            self.endpoint = endpoint.rstrip("/") + "/v1/traces"
+        self.spool_path = spool_path
+        self.max_spool_bytes = max_spool_bytes
+        self.resource_attrs = dict(resource_attrs or {})
+        self.timeout = timeout
+        self._spool_lock = threading.Lock()
+        # one long-lived HTTP session per (exporter, event loop): a
+        # fresh session per round would re-handshake TCP/TLS to the
+        # collector every period, forever
+        self._session = None
+        self._session_loop = None
+
+    @property
+    def active(self) -> bool:
+        return bool(self.endpoint or self.spool_path)
+
+    # ------------------------------------------------------------- sinks
+    def _count(self, sink: str) -> None:
+        from .. import metrics
+
+        metrics.OTLP_EXPORT_ROUNDS.labels(sink=sink).inc()
+
+    def spool(self, payload: dict) -> bool:
+        """Append one export payload to the bounded NDJSON ring."""
+        if not self.spool_path:
+            return False
+        line = json.dumps(payload, separators=(",", ":")) + "\n"
+        try:
+            with self._spool_lock:
+                d = os.path.dirname(self.spool_path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                try:
+                    size = os.path.getsize(self.spool_path)
+                except OSError:
+                    size = 0
+                if size + len(line) > self.max_spool_bytes and size > 0:
+                    os.replace(self.spool_path, self.spool_path + ".1")
+                with open(self.spool_path, "a", encoding="utf-8") as f:
+                    f.write(line)
+            return True
+        except OSError:
+            return False
+
+    async def _get_session(self):
+        """The cached collector session, rebuilt when absent, closed,
+        or bound to a previous event loop (sessions are loop-bound;
+        tests run one loop per test)."""
+        import aiohttp
+
+        loop = asyncio.get_running_loop()
+        if (self._session is None or self._session.closed
+                or self._session_loop is not loop):
+            if self._session is not None and not self._session.closed:
+                try:
+                    await self._session.close()
+                except Exception:  # noqa: BLE001 — cross-loop close is
+                    pass           # best-effort; the old loop is gone
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.timeout))
+            self._session_loop = loop
+        return self._session
+
+    async def _post(self, payload: dict) -> bool:
+        try:
+            s = await self._get_session()
+            async with s.post(self.endpoint, json=payload) as r:
+                return r.status < 300
+        except Exception:  # noqa: BLE001 — collector outage is routine
+            return False
+
+    # ------------------------------------------------------------ export
+    def export_round_sync(self, rec: dict) -> str:
+        """Spool-only synchronous export (no loop): 'spool'/'dropped'."""
+        payload = round_to_otlp(rec, self.resource_attrs)
+        sink = "spool" if self.spool(payload) else "dropped"
+        self._count(sink)
+        return sink
+
+    async def export_round(self, rec: dict) -> str:
+        """POST when an endpoint is configured, spool as the fallback
+        (and as the primary sink when no endpoint is set)."""
+        payload = round_to_otlp(rec, self.resource_attrs)
+        if self.endpoint and await self._post(payload):
+            self._count("http")
+            return "http"
+        sink = "spool" if self.spool(payload) else "dropped"
+        self._count(sink)
+        return sink
+
+
+# ---------------------------------------------------------------------------
+# Per-process exporter + the store-side hook
+# ---------------------------------------------------------------------------
+
+_EXPORTER: OTLPExporter | None = None
+_CONFIGURED = False
+
+
+def exporter() -> OTLPExporter | None:
+    """The env-configured per-process exporter, or None when neither
+    DRAND_TPU_OTLP_ENDPOINT nor DRAND_TPU_OTLP_SPOOL is set."""
+    global _EXPORTER, _CONFIGURED
+    if not _CONFIGURED:
+        endpoint = os.environ.get("DRAND_TPU_OTLP_ENDPOINT") or None
+        spool = os.environ.get("DRAND_TPU_OTLP_SPOOL") or None
+        if endpoint or spool:
+            _EXPORTER = OTLPExporter(
+                endpoint=endpoint, spool_path=spool,
+                max_spool_bytes=int(os.environ.get(
+                    "DRAND_TPU_OTLP_SPOOL_MAX", str(4 << 20))))
+        _CONFIGURED = True
+    return _EXPORTER
+
+
+def reset_exporter() -> None:
+    """Drop the cached exporter so env changes take effect (tests)."""
+    global _EXPORTER, _CONFIGURED
+    _EXPORTER = None
+    _CONFIGURED = False
+
+
+# strong references to in-flight export tasks: the loop holds tasks
+# weakly, and a GC'd task would silently drop a round's trace
+_PENDING_TASKS: set = set()
+
+
+def note_round_complete(round_no: int, chain: bytes | str = b"") -> None:
+    """A round's beacon was stored: flush its timeline off the hot path.
+    Deferred one loop turn so the caller's still-open spans (``store``)
+    land in the exported record; a no-op when the exporter is off or
+    the ring holds nothing for the round — catch-up traffic is
+    retain=False and never creates ring entries, so a node replaying a
+    year-old chain schedules nothing per historical round."""
+    exp = exporter()
+    if exp is None or not exp.active:
+        return
+    trace_id = round_trace_id(round_no, chain)
+    if TRACER.get_trace(trace_id) is None:
+        return
+
+    async def _flush_async() -> None:
+        rec = TRACER.get_trace(trace_id)
+        if rec and rec["spans"]:
+            await exp.export_round(rec)
+
+    try:
+        loop = asyncio.get_running_loop()
+    except RuntimeError:
+        rec = TRACER.get_trace(trace_id)
+        if rec and rec["spans"]:
+            exp.export_round_sync(rec)
+        return
+
+    def _spawn() -> None:
+        task = loop.create_task(_flush_async())
+        _PENDING_TASKS.add(task)
+        task.add_done_callback(_PENDING_TASKS.discard)
+
+    loop.call_soon(_spawn)

@@ -1,0 +1,201 @@
+"""Chain-health state and SLOs — the node-level "is this chain alive
+and on time?" answer (ISSUE 6).
+
+One per-process :class:`HealthState` (``HEALTH``, like ``TRACER``)
+accumulates what the metrics catalogue's chain-health set exposes:
+
+- **lateness**: every stored beacon's actual emit time vs its scheduled
+  round boundary (``beacon_round_lateness_seconds``), fed by the
+  DiscrepancyStore decorator on the store path.
+- **head / lag**: ``chain_head_round`` and ``chain_head_lag_rounds``
+  (wall-clock expected round minus stored head), re-evaluated both on
+  store and on every ``/healthz`` request — so a *stalled* chain (no
+  stores happening at all, e.g. a peer died and the group lost
+  threshold) still moves its gauges.
+- **missed rounds**: a round is *missed* once a full next boundary has
+  passed with no beacon stored for it. Counted exactly once per round
+  (``beacon_rounds_missed_total``); a later catch-up does not uncount —
+  the round WAS missed when its consumers needed it.
+- **SLO**: sliding window over the last ``window`` stored rounds; a
+  round is *late* when it landed more than ``period/2`` after its
+  boundary. ``beacon_slo_late_fraction`` is the window's late fraction.
+- **catch-up progress**: ``follow_chain`` reports rounds/sec and an ETA
+  so a node syncing a year-old chain is observable instead of silent.
+
+Readiness (``/readyz``) flips on DKG-complete (chain info exists) AND
+head-lag at or below ``DRAND_TPU_READY_MAX_LAG`` (default 3 rounds).
+
+Everything here is cheap (a lock, a deque, gauge sets) and per-process
+— in-process multi-node test harnesses share one HealthState exactly
+like they share the prometheus registries; tests reset() it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+
+READY_MAX_LAG = int(os.environ.get("DRAND_TPU_READY_MAX_LAG", "3"))
+
+
+class HealthState:
+    def __init__(self, window: int = 64):
+        self.window = window
+        self._lock = threading.Lock()
+        self._dkg_complete = False
+        self._head_round = 0
+        self._expected_round = 0
+        # highest round already counted into beacon_rounds_missed_total
+        # (start at -1 so round 0 / genesis never looks "new")
+        self._missed_marker = -1
+        self._missed_total = 0
+        # (round, late: bool) ring for the SLO window
+        self._late_ring: deque[tuple[int, bool]] = deque(maxlen=window)
+        # follow_chain progress
+        self._sync = {"active": False, "rounds_per_sec": 0.0,
+                      "eta_seconds": 0.0, "done": 0, "target": 0,
+                      "current": 0}
+
+    # ------------------------------------------------------------ inputs
+    def note_dkg_complete(self) -> None:
+        with self._lock:
+            self._dkg_complete = True
+
+    def note_round_stored(self, round_no: int, lateness_s: float,
+                          period: int) -> None:
+        """One beacon landed on the chain: lateness histogram, head
+        gauge, SLO window. Called by the DiscrepancyStore decorator —
+        off the crypto hot path (the beacon is already recovered).
+
+        Rounds stored more than two whole periods after their boundary
+        are catch-up/backfill (a rejoining node replaying history), not
+        live emissions: they advance the head but are excluded from the
+        lateness histogram and the SLO ring — their slots were already
+        captured by the missed-round counter, and hours-stale samples
+        would peg the SLO at 1.0 for a perfectly healthy group."""
+        from .. import metrics
+
+        live = lateness_s <= 2 * period
+        if live:
+            metrics.BEACON_LATENESS.observe(max(0.0, lateness_s))
+        with self._lock:
+            if round_no <= self._head_round:
+                return  # replay/rollback writes never regress the head
+            self._head_round = round_no
+            if live:
+                self._late_ring.append((round_no,
+                                        lateness_s > period / 2))
+            late = sum(1 for _, is_late in self._late_ring if is_late)
+            frac = late / len(self._late_ring) if self._late_ring else 0.0
+        metrics.CHAIN_HEAD_ROUND.set(round_no)
+        metrics.SLO_LATE_FRACTION.set(frac)
+
+    def observe_chain(self, now: float, period: int, genesis: int,
+                      head_round: int | None = None) -> dict:
+        """Re-evaluate lag + missed rounds against the wall clock —
+        called on store AND from /healthz, so a fully stalled chain
+        still surfaces (pull-model: scrapes and health probes drive the
+        gauges when no beacons do). Returns a snapshot dict."""
+        from ..chain import time_math
+        from .. import metrics
+
+        expected = time_math.current_round(int(now), period, genesis)
+        with self._lock:
+            if head_round is not None and head_round > self._head_round:
+                self._head_round = head_round
+            head = self._head_round
+            self._expected_round = expected
+            lag = max(0, expected - head)
+            # rounds in (head, expected-1] have had their WHOLE period
+            # elapse unstored — each is missed, counted once. Guarded on
+            # a KNOWN head: with head 0 (fresh relay before its first
+            # successful tip fetch, pre-first-beacon node) "missing"
+            # would be the entire chain height — a transient fetch
+            # failure must not permanently inflate a Counter.
+            overdue_to = expected - 1
+            newly = 0
+            if head > 0 and overdue_to > head:
+                lo = max(head, self._missed_marker)
+                newly = max(0, overdue_to - lo)
+            if newly:
+                self._missed_total += newly
+            if head > 0:
+                self._missed_marker = max(self._missed_marker, overdue_to,
+                                          head)
+            missed = self._missed_total
+        metrics.CHAIN_HEAD_LAG.set(lag)
+        if newly:
+            metrics.MISSED_ROUNDS.inc(newly)
+        return {"head_round": head, "expected_round": expected,
+                "lag_rounds": lag, "missed_total": missed}
+
+    def note_sync_progress(self, done: int, elapsed_s: float,
+                           current: int, target: int,
+                           active: bool = True) -> None:
+        """follow_chain catch-up progress: ``done`` rounds stored over
+        ``elapsed_s`` of this follow, chain at ``current``, aiming for
+        ``target`` (0 = unbounded live follow)."""
+        from .. import metrics
+
+        rps = done / elapsed_s if (active and elapsed_s > 0) else 0.0
+        if not active:
+            eta = 0.0
+        elif target <= 0:
+            eta = -1.0  # unbounded follow: no finish line to estimate
+        elif rps > 0:
+            eta = max(0.0, (target - current) / rps)
+        else:
+            eta = -1.0
+        with self._lock:
+            self._sync = {"active": active,
+                          "rounds_per_sec": round(rps, 3),
+                          "eta_seconds": round(eta, 3),
+                          "done": done, "target": target,
+                          "current": current}
+        metrics.SYNC_ROUNDS_PER_SEC.set(rps)
+        metrics.SYNC_ETA_SECONDS.set(eta)
+
+    # ----------------------------------------------------------- outputs
+    def snapshot(self) -> dict:
+        with self._lock:
+            late = sum(1 for _, is_late in self._late_ring if is_late)
+            n = len(self._late_ring)
+            return {
+                "dkg_complete": self._dkg_complete,
+                "head_round": self._head_round,
+                "expected_round": self._expected_round,
+                "lag_rounds": max(0, self._expected_round
+                                  - self._head_round),
+                "missed_total": self._missed_total,
+                "slo_window": n,
+                "slo_late_fraction": (late / n) if n else 0.0,
+                "sync": dict(self._sync),
+            }
+
+    def reset(self) -> None:
+        """Back to boot state (tests — the singleton is per-process)."""
+        with self._lock:
+            self._dkg_complete = False
+            self._head_round = 0
+            self._expected_round = 0
+            self._missed_marker = -1
+            self._missed_total = 0
+            self._late_ring.clear()
+            self._sync = {"active": False, "rounds_per_sec": 0.0,
+                          "eta_seconds": 0.0, "done": 0, "target": 0,
+                          "current": 0}
+
+
+def is_ready(snapshot: dict, max_lag: int | None = None) -> bool:
+    """THE readiness rule, shared by /healthz and /readyz: head lag at
+    or below the bound. The HTTP layer gates on chain info being
+    servable first (a relay has no DKG; info availability is its
+    completeness proxy) — keep the lag criterion here so the two
+    handlers cannot drift."""
+    limit = READY_MAX_LAG if max_lag is None else max_lag
+    return snapshot["lag_rounds"] <= limit
+
+
+# The per-process health state every producer/probe shares (like TRACER).
+HEALTH = HealthState()

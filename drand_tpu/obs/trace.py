@@ -322,8 +322,24 @@ class Tracer:
                         "spans": list(rec["spans"])})
         return out
 
+    def get_trace(self, trace_id: str) -> dict | None:
+        """A copy of one retained round timeline (the OTLP exporter's
+        lookup), or None when the ring holds nothing for the id."""
+        with self._lock:
+            rec = self._traces.get(trace_id)
+            if rec is None:
+                return None
+            return {"trace_id": rec["trace_id"], "round": rec["round"],
+                    "dropped": rec["dropped"],
+                    "spans": list(rec["spans"])}
+
     def reset(self) -> None:
-        """Drop all retained traces (tests)."""
+        """Drop all retained traces (tests). Safe against concurrent
+        ``_record``: both take ``self._lock``, and ``_record`` re-reads
+        ``self._traces`` under it — a span closing mid-reset either
+        lands before the clear (and is dropped with everything else) or
+        re-creates a fresh ring entry after it; never a KeyError or a
+        write into an orphaned record."""
         with self._lock:
             self._traces.clear()
 
@@ -331,3 +347,41 @@ class Tracer:
 # The per-process tracer every instrumentation site shares (the ring is
 # per-process by design — ISSUE: continuous in-process stage timing).
 TRACER = Tracer()
+
+
+def merge_round_timelines(sources: list[tuple[str, dict]]) -> list[dict]:
+    """Cross-node timeline merge: interleave several nodes'
+    ``/debug/trace/rounds`` payloads into one timeline per trace id.
+
+    The trace id of round *r* is ``blake2b(chain || r)`` on EVERY node,
+    so the same round's spans from different nodes share an id and can
+    be stitched with zero coordination — this is the payoff of the
+    deterministic-id design (``drand util trace --merge``).
+
+    ``sources``: ``(node_label, payload)`` pairs. Returns one record per
+    trace id — ``{"trace_id", "round", "nodes", "dropped", "spans"}`` —
+    spans interleaved by wall-clock start, each tagged with its source
+    label under ``"node"``; records ordered most-recent-round first
+    (unknown rounds last)."""
+    merged: dict[str, dict] = {}
+    for label, payload in sources:
+        for rec in (payload or {}).get("rounds", ()):
+            tid = rec.get("trace_id")
+            if not tid:
+                continue
+            out = merged.setdefault(tid, {
+                "trace_id": tid, "round": rec.get("round"),
+                "nodes": [], "dropped": 0, "spans": []})
+            if out["round"] is None:
+                out["round"] = rec.get("round")
+            if label not in out["nodes"]:
+                out["nodes"].append(label)
+            out["dropped"] += rec.get("dropped", 0) or 0
+            for sp in rec.get("spans", ()):
+                sp = dict(sp)
+                sp["node"] = label
+                out["spans"].append(sp)
+    for out in merged.values():
+        out["spans"].sort(key=lambda s: s.get("start") or 0.0)
+    return sorted(merged.values(),
+                  key=lambda r: (r["round"] is None, -(r["round"] or 0)))

@@ -262,6 +262,51 @@ class BatchedEngine:
             curve.F2, curve.msm_lanes(curve.F2, msg_jac, bits))
         return ok, sx, sy, sinf, mx, my, minf
 
+    # ---------------------------------------------------- introspection
+    def introspect(self) -> dict:
+        """JSON-ready snapshot of the engine's runtime state for
+        ``GET /debug/engine`` / ``drand util engine`` (ISSUE 6):
+        backend/device identity, the bucket configuration, and every
+        graph family's per-shape KAT-gate verdicts (True = proven,
+        False = disabled after a failed known-answer probe; shapes not
+        listed were never dispatched). Reading the KAT caches never
+        triggers a probe — the report reflects what actually ran."""
+        devices = []
+        try:
+            devices = [str(d) for d in jax.devices()]
+        except Exception:  # noqa: BLE001 — a dying tunnel must not 500
+            pass
+        return {
+            "backend": jax.default_backend(),
+            "devices": devices,
+            "mesh": (None if self.mesh is None
+                     else {"axes": list(self.mesh.axis_names),
+                           "size": int(self.mesh.devices.size)}),
+            "buckets": list(self.buckets),
+            "wire_buckets": list(self._wire_buckets()),
+            "wire_rlc_buckets": list(self._wire_rlc_buckets()),
+            "rlc_lane_buckets": list(self.rlc_lane_buckets),
+            "rlc_min": self.rlc_min,
+            "wire_prep": self.wire_prep,
+            "pallas_min_bucket": PALLAS_MIN_BUCKET,
+            "kat": {
+                "verify": {str(b): ok
+                           for b, ok in sorted(self._bucket_ok.items())},
+                "wire": {str(b): ok
+                         for b, ok in sorted(self._wire_ok.items())},
+                "rlc": {f"{kind}/{lanes}": ok for (kind, lanes), ok
+                        in sorted(self._rlc_ok.items())},
+                "wire_rlc": {str(b): ok for b, ok
+                             in sorted(self._wire_rlc_ok.items())},
+                "eval": {f"t{t}/b{b}": ok for (t, b), ok
+                         in sorted(self._eval_ok.items())},
+                "poly_eval": {f"t{t}/b{b}": ok for (t, b), ok
+                              in sorted(self._poly_eval_ok.items())},
+                "agg": {f"b{b}/msm{m}": ok for (b, m), ok
+                        in sorted(self._agg_ok.items())},
+            },
+        }
+
     # -- hashing (host, memoized: the aggregator re-verifies the same round
     #    message for every partial) -----------------------------------------
     def _hash_msg(self, msg: bytes, dst: bytes) -> PointG2:

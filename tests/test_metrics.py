@@ -85,20 +85,28 @@ class _FakeEngine:
 
 def test_engine_dispatch_metrics():
     """engine_device_batches (the ISSUE 1 dead-metric fix) and
-    engine_op_seconds{op,path,batch} move at the dispatch sites."""
+    engine_op_seconds{op,path,batch} move at the dispatch sites; the
+    FIRST dispatch of a cold device shape lands in
+    engine_compile_seconds{op} instead (ISSUE 6 compile split), so the
+    steady-state series only moves from the second call on."""
     old = (batch._MODE, batch._MIN_BATCH, batch._ENGINE)
     batch.configure("device", min_batch=1, engine=_FakeEngine())
     try:
         b0 = _sample_count(metrics.REGISTRY, "engine_device_batches",
                            op="verify_partials")
-        d0 = _sample_count(metrics.REGISTRY, "engine_op_seconds",
+        assert batch.verify_partials(None, b"m", [b"p1", b"p2"]) == [True, True]
+        # shape (verify_partials, device, "8") is warm now — whether this
+        # call or an earlier test paid the compile sample
+        d1 = _sample_count(metrics.REGISTRY, "engine_op_seconds",
                            op="verify_partials", path="device", batch="8")
         assert batch.verify_partials(None, b"m", [b"p1", b"p2"]) == [True, True]
         assert _sample_count(metrics.REGISTRY, "engine_device_batches",
-                             op="verify_partials") == b0 + 1
+                             op="verify_partials") == b0 + 2
         assert _sample_count(metrics.REGISTRY, "engine_op_seconds",
                              op="verify_partials", path="device",
-                             batch="8") == d0 + 1
+                             batch="8") == d1 + 1
+        assert _sample_count(metrics.REGISTRY, "engine_compile_seconds",
+                             op="verify_partials") >= 1
     finally:
         batch._MODE, batch._MIN_BATCH, batch._ENGINE = old
 

@@ -1,0 +1,551 @@
+"""Chain-health SLOs, OTLP span export, engine introspection (ISSUE 6).
+
+Late-alphabet filename on purpose: tier-1 on the 1-core box runs in
+chunks (tools/tier1_chunks.sh) and the capped single invocation keeps
+its early-dot throughput when newer suites sort last (ROADMAP
+operational constraint). Everything here is host-only crypto — no
+device graphs, no fresh XLA compiles.
+"""
+
+import asyncio
+import os
+import threading
+
+import aiohttp
+import pytest
+from aiohttp import web
+from conftest import sample_count as _sample_count
+
+from drand_tpu import metrics
+from drand_tpu.chain.beacon import Beacon, message
+from drand_tpu.client.direct import DirectClient
+from drand_tpu.crypto import batch, bls
+from drand_tpu.http_server.debug import add_trace_routes
+from drand_tpu.http_server.server import PublicServer
+from drand_tpu.obs import export as obs_export
+from drand_tpu.obs import trace
+from drand_tpu.obs.health import HEALTH, HealthState
+from drand_tpu.testing.harness import BeaconTestNetwork
+
+N, T, PERIOD = 3, 2, 5
+
+
+def _make_chain(sk, n):
+    prev, out = b"\x42" * 32, []
+    for rnd in range(1, n + 1):
+        sig = bls.sign(sk, message(rnd, prev))
+        out.append(Beacon(round=rnd, previous_sig=prev, signature=sig))
+        prev = sig
+    return out
+
+
+async def _get(port, path):
+    async with aiohttp.ClientSession() as s:
+        async with s.get(f"http://127.0.0.1:{port}{path}") as r:
+            try:
+                body = await r.json()
+            except Exception:  # noqa: BLE001 — non-JSON error bodies
+                body = {}
+            return r.status, body
+
+
+# ---------------------------------------------------------------------------
+# healthz / readyz / lateness / SLO / OTLP store-flush (one harness run)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_healthz_readyz_transitions(monkeypatch, tmp_path):
+    """Live rounds -> /healthz ok + /readyz ready + lateness samples;
+    a stalled chain (nodes stopped, clock running) -> 503 lagging,
+    head-lag gauge up, missed-round counter incremented; the stored
+    rounds' timelines land in the OTLP spool as resourceSpans."""
+    spool = str(tmp_path / "otlp.ndjson")
+    monkeypatch.setenv("DRAND_TPU_OTLP_SPOOL", spool)
+    monkeypatch.delenv("DRAND_TPU_OTLP_ENDPOINT", raising=False)
+    obs_export.reset_exporter()
+    HEALTH.reset()
+    trace.TRACER.reset()
+    lat0 = _sample_count(metrics.GROUP_REGISTRY,
+                         "beacon_round_lateness_seconds")
+    net = BeaconTestNetwork(n=N, t=T, period=PERIOD)
+    await net.start_all()
+    await net.advance_to_genesis()
+    for _ in range(2):
+        await net.clock.advance(PERIOD)
+    for i in range(N):
+        await net.wait_round(i, 2)
+    server = PublicServer(DirectClient(net.nodes[0].handler),
+                          clock=net.clock)
+    site = await server.start("127.0.0.1", 0)
+    port = site._server.sockets[0].getsockname()[1]
+    try:
+        status, body = await _get(port, "/healthz")
+        assert status == 200 and body["status"] == "ok"
+        assert body["head_round"] >= 2
+        assert body["lag_rounds"] <= body["max_lag"]
+        assert 0.0 <= body["slo_late_fraction"] <= 1.0
+        status, body = await _get(port, "/readyz")
+        assert status == 200 and body["ready"] is True
+        # fake clock: rounds land on the boundary -> lateness samples
+        # exist and the SLO window saw no late rounds
+        assert _sample_count(metrics.GROUP_REGISTRY,
+                             "beacon_round_lateness_seconds") > lat0
+        assert metrics.SLO_LATE_FRACTION._value.get() == 0.0
+
+        # ---- stall: every node stops, wall clock keeps moving --------
+        net.stop_all()
+        missed0 = _sample_count(metrics.GROUP_REGISTRY,
+                                "beacon_rounds_missed")
+        await net.clock.advance(PERIOD * 10)
+        status, body = await _get(port, "/healthz")
+        assert status == 503 and body["status"] == "lagging"
+        assert body["lag_rounds"] > body["max_lag"]
+        assert metrics.CHAIN_HEAD_LAG._value.get() > 3
+        assert _sample_count(metrics.GROUP_REGISTRY,
+                             "beacon_rounds_missed") > missed0
+        status, body = await _get(port, "/readyz")
+        assert status == 503 and body["ready"] is False
+        assert "head lag" in body["reason"]
+        # probing again at the same clock must not double-count misses
+        again = _sample_count(metrics.GROUP_REGISTRY,
+                              "beacon_rounds_missed")
+        await _get(port, "/healthz")
+        assert _sample_count(metrics.GROUP_REGISTRY,
+                             "beacon_rounds_missed") == again
+    finally:
+        await server.stop()
+        net.stop_all()
+
+    # ---- OTLP spool: per-completed-round flush off the hot path -------
+    docs = obs_export.read_spool(spool)
+    assert docs, "no OTLP payloads spooled for the produced rounds"
+    seed = net.group.get_genesis_seed()
+    want = trace.round_trace_id(1, seed)
+    spans_by_trace = {}
+    for doc in docs:
+        for rs in doc["resourceSpans"]:
+            res_keys = {a["key"]: a["value"] for a in
+                        rs["resource"]["attributes"]}
+            assert res_keys["service.name"]["stringValue"] == "drand-tpu"
+            for ss in rs["scopeSpans"]:
+                for sp in ss["spans"]:
+                    spans_by_trace.setdefault(sp["traceId"], []).append(sp)
+    assert want in spans_by_trace
+    names = {sp["name"] for sp in spans_by_trace[want]}
+    assert "store" in names  # flushed AFTER the store span closed
+    for sp in spans_by_trace[want]:
+        assert int(sp["endTimeUnixNano"]) >= int(sp["startTimeUnixNano"])
+
+
+# ---------------------------------------------------------------------------
+# OTLP spool unit round-trip + bounded rotation
+# ---------------------------------------------------------------------------
+
+def test_otlp_spool_roundtrip_and_bounds(tmp_path):
+    spool = str(tmp_path / "ring.ndjson")
+    exp = obs_export.OTLPExporter(spool_path=spool,
+                                  max_spool_bytes=8 * 1024)
+    tr = trace.Tracer()
+    with tr.activate(round_no=7, chain=b"chain-a"):
+        with tr.span("partial", node="a", have=3):
+            pass
+        with tr.span("store", v2=True):
+            pass
+    rec = tr.get_trace(trace.round_trace_id(7, b"chain-a"))
+    assert exp.export_round_sync(rec) == "spool"
+    docs = obs_export.read_spool(spool)
+    assert len(docs) == 1
+    spans = docs[0]["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert [s["name"] for s in spans] == ["partial", "store"]
+    assert {s["spanId"] for s in spans} == \
+        {s["span_id"] for s in rec["spans"]}
+    assert all(s["traceId"] == rec["trace_id"] for s in spans)
+    attrs = {a["key"]: a["value"] for a in spans[0]["attributes"]}
+    assert attrs["node"]["stringValue"] == "a"
+    assert attrs["have"]["intValue"] == "3"
+    assert attrs["drand.round"]["intValue"] == "7"
+
+    # bounded ring: many exports rotate instead of growing unbounded
+    for r in range(200):
+        with tr.activate(round_no=100 + r, chain=b"chain-a"):
+            with tr.span("collect", i=r):
+                pass
+        exp.export_round_sync(
+            tr.get_trace(trace.round_trace_id(100 + r, b"chain-a")))
+    total = sum(os.path.getsize(p) for p in (spool, spool + ".1")
+                if os.path.isfile(p))
+    assert os.path.isfile(spool + ".1")
+    assert total <= 2 * 8 * 1024 + 2048
+    assert obs_export.read_spool(spool)  # both files still parse
+
+
+@pytest.mark.asyncio
+async def test_otlp_endpoint_post_and_session_reuse(tmp_path):
+    """With an endpoint configured, rounds POST as OTLP/JSON to
+    /v1/traces over ONE long-lived session (no per-round reconnect);
+    a failing collector falls back to the spool."""
+    posts = []
+
+    async def collector(request):
+        posts.append(await request.json())
+        return web.json_response({})
+
+    app = web.Application()
+    app.add_routes([web.post("/v1/traces", collector)])
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    spool = str(tmp_path / "fallback.ndjson")
+    exp = obs_export.OTLPExporter(endpoint=f"http://127.0.0.1:{port}",
+                                  spool_path=spool)
+    assert exp.endpoint.endswith("/v1/traces")
+    tr = trace.Tracer()
+    try:
+        for r in (41, 42):
+            with tr.activate(round_no=r, chain=b"post-chain"):
+                with tr.span("recover"):
+                    pass
+            rec = tr.get_trace(trace.round_trace_id(r, b"post-chain"))
+            assert await exp.export_round(rec) == "http"
+        assert len(posts) == 2
+        assert posts[0]["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        first_session = exp._session
+        assert first_session is not None and not first_session.closed
+        await runner.cleanup()  # collector gone: spool fallback
+        with tr.activate(round_no=43, chain=b"post-chain"):
+            with tr.span("recover"):
+                pass
+        rec = tr.get_trace(trace.round_trace_id(43, b"post-chain"))
+        assert await exp.export_round(rec) == "spool"
+        assert exp._session is first_session  # reused, not rebuilt
+        assert len(obs_export.read_spool(spool)) == 1
+    finally:
+        if exp._session is not None and not exp._session.closed:
+            await exp._session.close()
+        await runner.cleanup()
+
+
+def test_otlp_env_exporter_and_counter(monkeypatch, tmp_path):
+    """note_round_complete with only the spool env set writes the spool
+    synchronously outside a loop and counts under sink="spool"."""
+    spool = str(tmp_path / "env.ndjson")
+    monkeypatch.setenv("DRAND_TPU_OTLP_SPOOL", spool)
+    monkeypatch.delenv("DRAND_TPU_OTLP_ENDPOINT", raising=False)
+    obs_export.reset_exporter()
+    try:
+        with trace.TRACER.activate(round_no=31, chain=b"env-chain"):
+            with trace.TRACER.span("recover"):
+                pass
+        c0 = _sample_count(metrics.REGISTRY, "otlp_export_rounds",
+                           sink="spool")
+        obs_export.note_round_complete(31, b"env-chain")
+        assert _sample_count(metrics.REGISTRY, "otlp_export_rounds",
+                             sink="spool") == c0 + 1
+        docs = obs_export.read_spool(spool)
+        assert docs and docs[0]["resourceSpans"]
+        # a round the ring never saw is a clean no-op
+        obs_export.note_round_complete(10**9, b"env-chain")
+        assert len(obs_export.read_spool(spool)) == len(docs)
+    finally:
+        obs_export.reset_exporter()
+
+
+# ---------------------------------------------------------------------------
+# health unit behavior
+# ---------------------------------------------------------------------------
+
+def test_health_missed_rounds_counted_once():
+    h = HealthState()
+    h.note_round_stored(5, 0.1, 30)
+    genesis, period = 1000, 30
+    now = genesis + period * 9  # expected round 10, head 5
+    snap = h.observe_chain(now, period, genesis)
+    assert snap["expected_round"] == 10
+    assert snap["lag_rounds"] == 5
+    assert snap["missed_total"] == 4  # rounds 6..9 fully elapsed
+    # same instant again: nothing new to count
+    assert h.observe_chain(now, period, genesis)["missed_total"] == 4
+    # chain catches up: misses stay counted, lag clears
+    for r in range(6, 11):
+        h.note_round_stored(r, 0.1, period)
+    snap = h.observe_chain(now, period, genesis)
+    assert snap["missed_total"] == 4 and snap["lag_rounds"] == 0
+
+
+def test_health_unknown_head_never_counts_missed():
+    """A head of 0 (fresh relay before its first successful tip fetch)
+    must not turn the whole chain height into missed rounds — a
+    transient fetch failure cannot permanently inflate a Counter."""
+    h = HealthState()
+    genesis, period = 1000, 30
+    snap = h.observe_chain(genesis + period * 1000, period, genesis,
+                           head_round=0)
+    assert snap["missed_total"] == 0
+    assert snap["lag_rounds"] > 0  # lag still reported
+    # once a real head exists, counting starts from there — not from 0
+    h.note_round_stored(995, 0.1, period)
+    snap = h.observe_chain(genesis + period * 1000, period, genesis)
+    assert snap["missed_total"] == snap["expected_round"] - 1 - 995
+
+
+def test_health_backfill_excluded_from_slo():
+    """Catch-up-stored rounds (lateness > 2 periods) advance the head
+    but never enter the lateness histogram or the SLO window."""
+    h = HealthState(window=8)
+    period = 30
+    lat0 = _sample_count(metrics.GROUP_REGISTRY,
+                         "beacon_round_lateness_seconds")
+    for r in range(1, 6):
+        h.note_round_stored(r, 3600.0, period)  # an hour stale: backfill
+    assert h.snapshot()["head_round"] == 5
+    assert h.snapshot()["slo_late_fraction"] == 0.0
+    assert _sample_count(metrics.GROUP_REGISTRY,
+                         "beacon_round_lateness_seconds") == lat0
+    h.note_round_stored(6, 0.2, period)  # live again
+    assert h.snapshot()["slo_late_fraction"] == 0.0
+    assert _sample_count(metrics.GROUP_REGISTRY,
+                         "beacon_round_lateness_seconds") == lat0 + 1
+
+
+def test_health_slo_window_and_sync_progress():
+    h = HealthState(window=4)
+    for r, late_by in enumerate((0.1, 20.0, 0.2, 21.0), start=1):
+        h.note_round_stored(r, late_by, 30)  # late threshold: 15 s
+    assert h.snapshot()["slo_late_fraction"] == 0.5
+    h.note_sync_progress(done=100, elapsed_s=10.0, current=500,
+                         target=1000)
+    snap = h.snapshot()["sync"]
+    assert snap["rounds_per_sec"] == 10.0
+    assert snap["eta_seconds"] == 50.0
+    assert metrics.SYNC_ROUNDS_PER_SEC._value.get() == 10.0
+    h.note_sync_progress(0, 0.0, 0, 0, active=False)
+    assert metrics.SYNC_ROUNDS_PER_SEC._value.get() == 0.0
+    assert metrics.SYNC_ETA_SECONDS._value.get() == 0.0
+    h.note_sync_progress(done=10, elapsed_s=1.0, current=50, target=0)
+    assert metrics.SYNC_ETA_SECONDS._value.get() == -1.0  # unbounded
+
+
+# ---------------------------------------------------------------------------
+# fallback ledger + compile-time split
+# ---------------------------------------------------------------------------
+
+class _WedgedEngine:
+    def wire_rlc_active(self, n):
+        return False
+
+    def verify_beacons(self, *a, **k):
+        raise RuntimeError("device wedged (test)")
+
+
+def test_fallback_ledger_bounds_and_dispatch(monkeypatch):
+    batch.reset_fallback_ledger()
+    for i in range(batch.FALLBACK_LEDGER_MAX + 40):
+        batch._ledger_note(f"op{i}", "device", "x" * 1000)
+    led = batch.fallback_ledger()
+    assert len(led) == batch.FALLBACK_LEDGER_MAX
+    assert led[-1]["op"] == f"op{batch.FALLBACK_LEDGER_MAX + 39}"
+    assert all(len(e["reason"]) <= 300 for e in led)
+
+    # a real device failure through the dispatcher lands an entry with
+    # op/path/reason and still returns the host verdicts
+    batch.reset_fallback_ledger()
+    monkeypatch.delenv("DRAND_TPU_BATCH_VERIFY", raising=False)
+    sk, pub = bls.keygen(seed=b"ledger-test")
+    beacons = _make_chain(sk, 2)
+    old = (batch._MODE, batch._MIN_BATCH, batch._ENGINE)
+    batch.configure("auto", min_batch=1, engine=_WedgedEngine())
+    try:
+        out = batch.verify_beacons(pub, beacons)
+        assert out.all() and len(out) == 2
+    finally:
+        batch._MODE, batch._MIN_BATCH, batch._ENGINE = old
+    led = batch.fallback_ledger()
+    assert len(led) == 1
+    assert led[0]["op"] == "verify_beacons"
+    assert led[0]["path"] == "device"
+    assert "device wedged" in led[0]["reason"]
+
+
+def test_compile_seconds_first_call_split():
+    op = "zz_obs_test_op"
+    key = (op, "device", "8")
+    batch._WARM_SHAPES.discard(key)
+    c0 = _sample_count(metrics.REGISTRY, "engine_compile_seconds", op=op)
+    o0 = _sample_count(metrics.REGISTRY, "engine_op_seconds", op=op,
+                       path="device", batch="8")
+    with batch._timed(op, "device", 8):
+        pass
+    assert _sample_count(metrics.REGISTRY, "engine_compile_seconds",
+                         op=op) == c0 + 1
+    assert _sample_count(metrics.REGISTRY, "engine_op_seconds", op=op,
+                         path="device", batch="8") == o0
+    with batch._timed(op, "device", 8):
+        pass  # warm now: steady-state series moves
+    assert _sample_count(metrics.REGISTRY, "engine_op_seconds", op=op,
+                         path="device", batch="8") == o0 + 1
+    # host paths never divert (no compile to split out)
+    h0 = _sample_count(metrics.REGISTRY, "engine_op_seconds", op=op,
+                       path="host", batch="8")
+    with batch._timed(op, "host", 8):
+        pass
+    assert _sample_count(metrics.REGISTRY, "engine_op_seconds", op=op,
+                         path="host", batch="8") == h0 + 1
+    assert _sample_count(metrics.REGISTRY, "engine_compile_seconds",
+                         op=op) == c0 + 1
+    # a FAILED first dispatch stays in <path>_error and does not warm
+    op2 = "zz_obs_test_op_fail"
+    batch._WARM_SHAPES.discard((op2, "device", "8"))
+    with pytest.raises(RuntimeError):
+        with batch._timed(op2, "device", 8):
+            raise RuntimeError("boom")
+    assert _sample_count(metrics.REGISTRY, "engine_op_seconds", op=op2,
+                         path="device_error", batch="8") == 1
+    assert (op2, "device", "8") not in batch._WARM_SHAPES
+
+
+# ---------------------------------------------------------------------------
+# cross-node timeline merge (util trace --merge core)
+# ---------------------------------------------------------------------------
+
+def test_merge_two_tracers_interleaves_shared_round():
+    """Two nodes' rings, same deterministic trace id: the merge yields
+    ONE timeline with both nodes' spans ordered by wall-clock start."""
+    seed = b"merge-chain"
+    ta, tb = trace.Tracer(), trace.Tracer()
+    with ta.activate(round_no=9, chain=seed):
+        with ta.span("partial", node="a"):
+            pass
+    with tb.activate(round_no=9, chain=seed):
+        with tb.span("partial_verify", node="b"):
+            pass
+    with ta.activate(round_no=9, chain=seed):
+        with ta.span("store", node="a"):
+            pass
+    # an unshared round on node b only
+    with tb.activate(round_no=10, chain=seed):
+        with tb.span("partial", node="b"):
+            pass
+    merged = trace.merge_round_timelines([
+        ("http://a:1", {"rounds": ta.rounds(8)}),
+        ("http://b:1", {"rounds": tb.rounds(8)}),
+    ])
+    by_round = {m["round"]: m for m in merged}
+    shared = by_round[9]
+    assert shared["trace_id"] == trace.round_trace_id(9, seed)
+    assert shared["nodes"] == ["http://a:1", "http://b:1"]
+    assert [s["name"] for s in shared["spans"]] == \
+        ["partial", "partial_verify", "store"]
+    assert [s["node"] for s in shared["spans"]] == \
+        ["http://a:1", "http://b:1", "http://a:1"]
+    starts = [s["start"] for s in shared["spans"]]
+    assert starts == sorted(starts)
+    assert by_round[10]["nodes"] == ["http://b:1"]
+    assert merged[0]["round"] == 10  # most recent first
+
+
+# ---------------------------------------------------------------------------
+# /debug/trace/rounds hardening + /debug/engine + Tracer.reset race
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_trace_rounds_n_validation_and_engine_endpoint():
+    app = web.Application()
+    add_trace_routes(app)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    try:
+        # NB: a literal '+' in a query string decodes to a space, so the
+        # explicit-sign probes are percent-encoded
+        for q, want in (("zzz", 400), ("1.5", 400), ("1e3", 400),
+                        ("0x10", 400), ("", 400), ("%2B-5", 400),
+                        ("-5", 200), ("0", 200), ("999999999", 200),
+                        ("%2B7", 200), ("8", 200)):
+            status, body = await _get(port, f"/debug/trace/rounds?n={q}")
+            assert status == want, f"n={q!r} -> {status}, want {want}"
+            if want == 200:
+                assert "rounds" in body
+        status, body = await _get(port, "/debug/engine")
+        assert status == 200
+        assert body["mode"] in ("auto", "device", "host")
+        assert isinstance(body["engine_created"], bool)
+        assert isinstance(body["fallback_ledger"], list)
+        assert set(body["h2c_cache"]) >= {"hits", "misses", "size"}
+        assert isinstance(body["warm_shapes"], list)
+    finally:
+        await runner.cleanup()
+
+
+def test_tracer_reset_safe_against_concurrent_record():
+    t = trace.Tracer(max_rounds=8, max_spans=64)
+    stop = threading.Event()
+    errs = []
+
+    def hammer(i):
+        try:
+            while not stop.is_set():
+                with t.activate(round_no=i, chain=b"race"):
+                    with t.span("s", i=i):
+                        pass
+        except Exception as e:  # noqa: BLE001 — any raise fails the test
+            errs.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(i,))
+               for i in range(4)]
+    for th in threads:
+        th.start()
+    try:
+        for _ in range(300):
+            t.reset()
+            t.rounds(8)
+    finally:
+        stop.set()
+        for th in threads:
+            th.join(timeout=10)
+    assert not errs
+    assert all(not th.is_alive() for th in threads)
+    for rec in t.rounds(8):  # ring left structurally consistent
+        assert set(rec) == {"trace_id", "round", "dropped", "spans"}
+
+
+# ---------------------------------------------------------------------------
+# engine introspection dict (no device engine needed: shape only)
+# ---------------------------------------------------------------------------
+
+def test_engine_introspect_json_shape():
+    """introspect() must be JSON-ready (string keys for tuple-keyed KAT
+    caches) — exercised against a real BatchedEngine only when some
+    other suite in this process already created one; otherwise a stub
+    engine with populated caches checks the key conversion."""
+    import json as _json
+
+    eng = batch._ENGINE
+    if eng is None or not hasattr(eng, "introspect"):
+        from drand_tpu.ops.engine import BatchedEngine
+
+        eng = BatchedEngine.__new__(BatchedEngine)  # no jit/compile
+        eng.buckets = (4, 128)
+        eng.mesh = None
+        eng.rlc_min = 8
+        eng.rlc_lane_buckets = (8, 32)
+        eng.wire_prep = None
+        eng._bucket_ok = {4: True}
+        eng._wire_ok = {128: False}
+        eng._rlc_ok = {("g2g2", 8): True}
+        eng._wire_rlc_ok = {32: True}
+        eng._eval_ok = {(2, 32): True}
+        eng._poly_eval_ok = {}
+        eng._agg_ok = {(4, 8): False}
+    data = eng.introspect()
+    _json.dumps(data)  # every key/value serializes
+    assert data["backend"]
+    kat = data["kat"]
+    assert set(kat) == {"verify", "wire", "rlc", "wire_rlc", "eval",
+                        "poly_eval", "agg"}
+    for family in kat.values():
+        for k, v in family.items():
+            assert isinstance(k, str) and isinstance(v, bool)

@@ -3,10 +3,18 @@
 referenced at least once outside its declaration module (no dead
 catalogue entries — the `engine_device_batches` regression, ISSUE 1),
 metric names must be unique across the four registries (a duplicate
-name silently splits one logical series across registries), and the
-engine_op_seconds ``path`` label values used at the dispatch sites must
-come from the documented set (a typo'd path label would silently fork
-the series operators alert on).
+name silently splits one logical series across registries), every
+declaration must carry real help text (ISSUE 6: operators read the
+catalogue off /metrics), and the engine_op_seconds ``path`` label
+values used at the dispatch sites must come from the documented set (a
+typo'd path label would silently fork the series operators alert on).
+
+The Grafana dashboard (tools/grafana/drand_tpu.json) is cross-checked
+too: every metric its PromQL expressions reference must exist in the
+catalogue (counters may appear with the exposition-format ``_total``
+suffix, histograms with ``_bucket``/``_sum``/``_count``) — a dashboard
+panel silently flat at zero because of a renamed metric is exactly the
+failure mode this lint exists to catch.
 
 Run standalone (exit 1 on problems) or from the tier-1 suite
 (tests/test_metrics.py::test_metrics_lint) so regressions fail fast.
@@ -21,7 +29,23 @@ import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 METRICS_FILE = REPO / "drand_tpu" / "metrics" / "__init__.py"
+DASHBOARD_FILE = REPO / "tools" / "grafana" / "drand_tpu.json"
 _METRIC_TYPES = {"Counter", "Gauge", "Histogram", "Summary", "Info"}
+
+# PromQL functions/keywords/aggregators that appear as bare identifiers
+# in dashboard expressions and are NOT metric names
+_PROMQL_WORDS = {
+    "rate", "irate", "increase", "delta", "deriv", "sum", "avg", "min",
+    "max", "count", "by", "without", "on", "ignoring", "group_left",
+    "group_right", "histogram_quantile", "quantile", "topk", "bottomk",
+    "abs", "clamp_min", "clamp_max", "label_replace", "label_join",
+    "time", "vector", "scalar", "offset", "and", "or", "unless", "le",
+    "bool", "avg_over_time", "max_over_time", "min_over_time",
+    "sum_over_time", "count_over_time", "increase_over_time",
+}
+# exposition-format suffixes prometheus_client appends to the declared
+# name (counters -> _total; histograms -> _bucket/_sum/_count)
+_SAMPLE_SUFFIXES = ("_total", "_bucket", "_sum", "_count")
 
 # engine_op_seconds base path labels (crypto/batch.py _timed); the
 # _error/_invalid suffixes are appended dynamically on failure paths.
@@ -35,11 +59,12 @@ KNOWN_LABEL_VALUES = {"hash_to_g2_cache_requests": {"result": {"hit",
                                                                "miss"}}}
 
 
-def declared_metrics() -> dict[str, str]:
-    """python identifier -> prometheus metric name, parsed from the
-    module-level assignments in drand_tpu/metrics/__init__.py."""
+def _declarations() -> list[tuple[str, str, str]]:
+    """(python identifier, prometheus name, help text) triples parsed
+    from the module-level assignments in drand_tpu/metrics/__init__.py.
+    Help is the second positional arg ('' when absent/non-literal)."""
     tree = ast.parse(METRICS_FILE.read_text())
-    out: dict[str, str] = {}
+    out: list[tuple[str, str, str]] = []
     for node in tree.body:
         if not isinstance(node, ast.Assign) or len(node.targets) != 1:
             continue
@@ -52,9 +77,30 @@ def declared_metrics() -> dict[str, str]:
         if fn_name not in _METRIC_TYPES or not call.args:
             continue
         first = call.args[0]
-        if isinstance(first, ast.Constant) and isinstance(first.value, str):
-            out[target.id] = first.value
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            continue
+        help_text = ""
+        if len(call.args) > 1:
+            second = call.args[1]
+            if isinstance(second, ast.Constant) \
+                    and isinstance(second.value, str):
+                help_text = second.value
+            else:
+                # implicit adjacent-literal concatenation parses as a
+                # Constant already; anything else (f-string, name) is a
+                # lint problem surfaced by the empty help below
+                try:
+                    help_text = ast.literal_eval(second)
+                except (ValueError, SyntaxError):
+                    help_text = ""
+        out.append((target.id, first.value, help_text))
     return out
+
+
+def declared_metrics() -> dict[str, str]:
+    """python identifier -> prometheus metric name."""
+    return {py: name for py, name, _ in _declarations()}
 
 
 def _corpus() -> str:
@@ -105,19 +151,82 @@ def labels_used(corpus: str, identifier: str) -> dict[str, set[str]]:
     return out
 
 
+def dashboard_metric_refs(path: pathlib.Path = DASHBOARD_FILE) -> set[str]:
+    """Every metric-shaped identifier referenced by the dashboard's
+    PromQL expressions. Label selectors ``{...}`` and range selectors
+    ``[...]`` are stripped first (their contents are label names/values
+    and durations, not metrics); remaining identifiers that are not
+    PromQL functions/keywords are metric references — our catalogue
+    names all contain '_', which also filters stray words."""
+    import json
+
+    doc = json.loads(path.read_text())
+    exprs: list[str] = []
+
+    def walk(node):
+        if isinstance(node, dict):
+            if isinstance(node.get("expr"), str):
+                exprs.append(node["expr"])
+            for v in node.values():
+                walk(v)
+        elif isinstance(node, list):
+            for v in node:
+                walk(v)
+
+    walk(doc.get("panels", []))
+    refs: set[str] = set()
+    for expr in exprs:
+        cleaned = re.sub(r"\{[^}]*\}", "", expr)
+        cleaned = re.sub(r"\[[^\]]*\]", "", cleaned)
+        for tok in re.findall(r"[a-zA-Z_][a-zA-Z0-9_]*", cleaned):
+            if tok in _PROMQL_WORDS or "_" not in tok:
+                continue
+            refs.add(tok)
+    return refs
+
+
+def check_dashboard(decls: dict[str, str]) -> list[str]:
+    """Cross-check the Grafana dashboard against the catalogue."""
+    if not DASHBOARD_FILE.is_file():
+        return [f"dashboard missing: {DASHBOARD_FILE}"]
+    try:
+        refs = dashboard_metric_refs()
+    except ValueError as e:
+        return [f"dashboard is not valid JSON: {e}"]
+    if not refs:
+        return ["dashboard references no metrics (extractor broken?)"]
+    known = set(decls.values())
+    problems = []
+    for ref in sorted(refs):
+        candidates = {ref}
+        for suf in _SAMPLE_SUFFIXES:
+            if ref.endswith(suf):
+                candidates.add(ref[: -len(suf)])
+        if not candidates & known:
+            problems.append(
+                f"dashboard references unknown metric {ref!r} "
+                f"(tools/grafana/drand_tpu.json vs the catalogue)")
+    return problems
+
+
 def run_lint() -> list[str]:
     """-> list of problems (empty when clean)."""
     problems: list[str] = []
-    decls = declared_metrics()
+    triples = _declarations()
+    decls = {py: name for py, name, _ in triples}
     if not decls:
         return ["no metric declarations found (parser broken?)"]
     seen: dict[str, str] = {}
-    for py_name, metric_name in decls.items():
+    for py_name, metric_name, help_text in triples:
         if metric_name in seen:
             problems.append(
                 f"duplicate metric name {metric_name!r}: declared as both "
                 f"{seen[metric_name]} and {py_name}")
         seen[metric_name] = py_name
+        if len(help_text.strip()) < 10:
+            problems.append(
+                f"{py_name} ({metric_name!r}): missing/too-short help "
+                f"text — the catalogue is operator documentation")
     corpus = _corpus()
     for py_name, metric_name in sorted(decls.items()):
         if not re.search(rf"\b{re.escape(py_name)}\b", corpus):
@@ -154,6 +263,7 @@ def run_lint() -> list[str]:
                 problems.append(
                     f"{metric_name}: unexpected {key} label value(s) "
                     f"{sorted(bad)} (known: {sorted(expected.get(key, set()))})")
+    problems.extend(check_dashboard(decls))
     return problems
 
 

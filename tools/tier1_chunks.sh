@@ -12,9 +12,13 @@
 # spreads across chunks.
 #
 # Usage:
-#   tools/tier1_chunks.sh [N] [extra pytest args...]
+#   tools/tier1_chunks.sh [N] [--list] [extra pytest args...]
 # Env:
 #   CHUNK_TIMEOUT  seconds per chunk (default 870, the tier-1 cap)
+#
+# --list prints the chunk -> file assignment (one line per chunk) and
+# exits 0 without running anything, so a CI log's chunked verdicts are
+# auditable against exactly which files each chunk covered.
 #
 # Exit status: 0 iff every chunk passed.
 
@@ -23,15 +27,36 @@ cd "$(dirname "$0")/.."
 
 # first arg is N only when it is a positive integer — otherwise it is a
 # pytest arg and the default chunk count applies (a bad N must never
-# yield a zero-iteration loop that exits 0 without running anything)
+# yield a zero-iteration loop that exits 0 without running anything);
+# --list is accepted before or after N
 N=4
+LIST=0
+if [ "${1:-}" = "--list" ]; then
+    LIST=1
+    shift
+fi
 if [[ "${1:-}" =~ ^[0-9]+$ ]] && [ "$1" -ge 1 ]; then
     N=$1
+    shift
+fi
+if [ "${1:-}" = "--list" ]; then
+    LIST=1
     shift
 fi
 
 FILES=()
 while IFS= read -r f; do FILES+=("$f"); done < <(ls tests/test_*.py | sort)
+
+if [ "$LIST" -eq 1 ]; then
+    for ((i = 0; i < N; i++)); do
+        chunk=()
+        for ((j = i; j < ${#FILES[@]}; j += N)); do
+            chunk+=("${FILES[j]}")
+        done
+        echo "chunk $((i + 1))/$N: ${chunk[*]:-}"
+    done
+    exit 0
+fi
 
 fail=0
 for ((i = 0; i < N; i++)); do
