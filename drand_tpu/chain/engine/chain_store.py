@@ -16,6 +16,7 @@ from ...crypto import batch
 from ...net.packets import PartialBeaconPacket
 from ...net.transport import ProtocolClient
 from ...obs.trace import TRACER
+from ...utils.aio import spawn
 from ...utils.logging import KVLogger
 from .. import beacon as chain_beacon
 from .. import time_math
@@ -83,7 +84,7 @@ class ChainStore(CallbackStore):
         while True:
             kind, payload = await self._events.get()
             try:
-                last = self._process_event(kind, payload, cache, last)
+                last = await self._process_event(kind, payload, cache, last)
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # noqa: BLE001 — the aggregator task
@@ -92,8 +93,8 @@ class ChainStore(CallbackStore):
                 # silently halts the node
                 self._l.error("aggregator", "event_failed", err=repr(e))
 
-    def _process_event(self, kind: str, payload, cache: PartialCache,
-                       last: Beacon) -> Beacon:
+    async def _process_event(self, kind: str, payload, cache: PartialCache,
+                             last: Beacon) -> Beacon:
         if kind == "stored":
             last = payload
             cache.flush_rounds(last.round)
@@ -106,10 +107,10 @@ class ChainStore(CallbackStore):
             return last
         with TRACER.activate(round_no=p_round,
                              chain=self._crypto.chain_info.genesis_seed):
-            return self._process_partial(partial, cache, last)
+            return await self._process_partial(partial, cache, last)
 
-    def _process_partial(self, partial: _PartialInfo, cache: PartialCache,
-                         last: Beacon) -> Beacon:
+    async def _process_partial(self, partial: _PartialInfo, cache: PartialCache,
+                               last: Beacon) -> Beacon:
         p_round = partial.p.round
         group = self._crypto.get_group()
         thr, n = group.threshold, len(group)
@@ -125,7 +126,7 @@ class ChainStore(CallbackStore):
                       round=rc.round, have=f"{len(rc)}/{thr}")
         if len(rc) < thr:
             return last
-        new_beacon = self._aggregate(rc, thr, n)
+        new_beacon = await self._aggregate(rc, thr, n)
         if new_beacon is None:
             return last
         cache.flush_rounds(rc.round)
@@ -136,10 +137,10 @@ class ChainStore(CallbackStore):
         if new_beacon.round > last.round + 1:
             # aggregated a beacon ahead of our chain: catch up
             peers = [nd.identity for nd in group.nodes]
-            asyncio.ensure_future(self.sync.follow(new_beacon.round, peers))
+            spawn(self.sync.follow(new_beacon.round, peers))
         return last
 
-    def _aggregate(self, rc, thr: int, n: int) -> Beacon | None:
+    async def _aggregate(self, rc, thr: int, n: int) -> Beacon | None:
         """Recover + verify V1 and (when possible) V2 — the crypto hot path
         (chain/beacon/chain.go:136-166). Each chain's whole round work
         (partial re-verify + Lagrange recovery + recovered-signature
@@ -147,13 +148,21 @@ class ChainStore(CallbackStore):
         (batch.aggregate_round); recovery failure AND a recovered
         signature failing its pairing check both surface as ValueError.
         Partials were already signature-checked on ingress (handler.py),
-        so the in-graph re-verify costs no extra dispatches."""
+        so the in-graph re-verify costs no extra dispatches.
+
+        Runs on a worker thread (``asyncio.to_thread``): Lagrange
+        recovery + the recovered-signature pairing are tens of
+        milliseconds of host CPU (or a blocking device dispatch), and
+        the aggregator task shares the event loop with /healthz, gossip
+        and the DKG surfaces. to_thread copies contextvars, so the
+        recover/verify trace spans still land in the round timeline."""
         from ...crypto.tbls import RecoveredSignatureInvalid
 
         pub = self._crypto.get_pub()
         msg = rc.msg()
         try:
-            _, final_sig = batch.aggregate_round(
+            _, final_sig = await asyncio.to_thread(
+                batch.aggregate_round,
                 pub, msg, rc.partials(), thr, n, prevalidated=True)
         except RecoveredSignatureInvalid as e:
             # security-significant: individually-valid partials produced
@@ -167,7 +176,8 @@ class ChainStore(CallbackStore):
         if rc.len_v2() >= thr:
             msg_v2 = chain_beacon.message_v2(rc.round)
             try:
-                _, sig_v2 = batch.aggregate_round(
+                _, sig_v2 = await asyncio.to_thread(
+                    batch.aggregate_round,
                     pub, msg_v2, rc.partials_v2(), thr, n,
                     prevalidated=True)
             except RecoveredSignatureInvalid as e:

@@ -17,6 +17,7 @@ from ...key.keys import Node, Share
 from ...net.packets import PartialBeaconPacket, SyncRequest
 from ...net.transport import ProtocolClient, ProtocolService, TransportError
 from ...obs.trace import TRACER
+from ...utils.aio import spawn
 from ...utils.clock import Clock
 from ...utils.logging import KVLogger
 from .. import beacon as chain_beacon
@@ -36,6 +37,27 @@ class BeaconConfig:
     share: Share
     group: Group
     clock: Clock
+
+
+def _verify_partial_packet(pub, p: PartialBeaconPacket) -> str | None:
+    """The pairing-heavy half of partial ingress, shaped for
+    ``asyncio.to_thread`` (node.go:96-130). Returns the rejection
+    reason, or None when the packet is fully valid."""
+    msg = chain_beacon.message(p.round, p.previous_sig)
+    if not tbls.verify_partial(pub, msg, p.partial_sig):
+        return "invalid partial signature"
+    if p.partial_sig_v2:
+        # both partials must come from the same share index: otherwise a
+        # malicious member can pair its own V1 partial with a replayed
+        # honest V2 partial, inflating the V2 count with duplicate
+        # embedded indices and vetoing rounds (reference node.go:121-130
+        # lacks this check — fixed here).
+        if tbls.index_of(p.partial_sig_v2) != tbls.index_of(p.partial_sig):
+            return "partial signature index mismatch"
+        msg_v2 = chain_beacon.message_v2(p.round)
+        if not tbls.verify_partial(pub, msg_v2, p.partial_sig_v2):
+            return "invalid partial signature v2"
+    return None
 
 
 class Handler(ProtocolService):
@@ -73,7 +95,7 @@ class Handler(ProtocolService):
             int(self.conf.clock.now()), self.conf.group.period,
             self.conf.group.genesis_time)
         self._launch(ttime)
-        asyncio.ensure_future(self.chain.run_sync(n_round, None))
+        spawn(self.chain.run_sync(n_round, None))
 
     async def transition(self, prev_group: Group) -> None:
         """New node joining at a reshare: sync the old chain up to the
@@ -87,7 +109,7 @@ class Handler(ProtocolService):
             raise ValueError(f"transition time {target_time} not a round boundary")
         self._launch(target_time)
         peers = [nd.identity for nd in prev_group.nodes]
-        asyncio.ensure_future(self.chain.run_sync(t_round - 1, peers))
+        spawn(self.chain.run_sync(t_round - 1, peers))
 
     def transition_new_group(self, new_share: Share, new_group: Group) -> None:
         """Existing member: swap share exactly after round T-1 is stored
@@ -154,27 +176,16 @@ class Handler(ProtocolService):
                              chain=self.crypto.chain_info.genesis_seed), \
                 TRACER.span("partial_verify", node=self.addr,
                             sender=from_addr):
-            msg = chain_beacon.message(p.round, p.previous_sig)
-            pub = self.crypto.get_pub()
-            if not tbls.verify_partial(pub, msg, p.partial_sig):
-                self._l.error("process_partial", from_addr,
-                              err="invalid partial sig", round=p.round)
-                raise TransportError("invalid partial signature")
-            if p.partial_sig_v2:
-                # both partials must come from the same share index:
-                # otherwise a malicious member can pair its own V1 partial
-                # with a replayed honest V2 partial, inflating the V2 count
-                # with duplicate embedded indices and vetoing rounds
-                # (reference node.go:121-130 lacks this check — fixed here).
-                if tbls.index_of(p.partial_sig_v2) != tbls.index_of(p.partial_sig):
-                    self._l.error("process_partial_v2", from_addr,
-                                  err="v1/v2 index mismatch", round=p.round)
-                    raise TransportError("partial signature index mismatch")
-                msg_v2 = chain_beacon.message_v2(p.round)
-                if not tbls.verify_partial(pub, msg_v2, p.partial_sig_v2):
-                    self._l.error("process_partial_v2", from_addr,
-                                  err="invalid partial sig v2", round=p.round)
-                    raise TransportError("invalid partial signature v2")
+            # executor hand-off: up to four pairings per packet — run
+            # them on a worker thread so concurrent partial ingress,
+            # /healthz and gossip stay serviced (the gRPC gateway calls
+            # this once per peer per round, right at the boundary burst)
+            err = await asyncio.to_thread(
+                _verify_partial_packet, self.crypto.get_pub(), p)
+            if err is not None:
+                self._l.error("process_partial", from_addr, err=err,
+                              round=p.round)
+                raise TransportError(err)
             if tbls.index_of(p.partial_sig) == self.crypto.index():
                 # a reflected copy of our own partial: ignore
                 return
@@ -220,14 +231,13 @@ class Handler(ProtocolService):
                     if last.round + 1 < current.round:
                         # chain halted for a gap: sync with the group
                         self._l.debug("beacon_loop", run_sync_catchup=current.round)
-                        asyncio.ensure_future(
-                            self.chain.run_sync(current.round, None))
+                        spawn(self.chain.run_sync(current.round, None))
                 else:
                     b = payload
                     if b.round < self._current_round:
                         # network recovering: hurry the next beacon after a
                         # catchup-period breather (node.go:256-271)
-                        asyncio.ensure_future(self._delayed_broadcast(b))
+                        spawn(self._delayed_broadcast(b))
         except asyncio.CancelledError:
             self._l.debug("beacon_loop", "finished")
         finally:
@@ -272,7 +282,7 @@ class Handler(ProtocolService):
             for node in self.crypto.get_group().nodes:
                 if node.address() == self.addr:
                     continue
-                asyncio.ensure_future(self._send_partial(node, packet))
+                spawn(self._send_partial(node, packet))
 
     async def _send_partial(self, node, packet: PartialBeaconPacket) -> None:
         try:

@@ -138,12 +138,19 @@ class Syncer:
                 # retain=False: catch-up streams thousands of historical
                 # rounds — they must feed the histograms without evicting
                 # live round timelines from the bounded ring
+                # executor hand-off: a big span's multi-pairing work is
+                # seconds of CPU (or a blocking device dispatch) — run it
+                # on a worker thread so /healthz, gossip and DKG traffic
+                # keep being served mid-catch-up. to_thread copies the
+                # contextvars context, so the trace spans and
+                # engine_op_seconds samples land exactly as before.
                 with TRACER.activate(round_no=chunk[-1].round,
                                      chain=self._info.genesis_seed,
                                      retain=False), \
                         TRACER.span("sync_verify", chunk=len(chunk),
                                     peer=_addr(peer)):
-                    oks = batch.verify_beacons(self._info.public_key, chunk)
+                    oks = await asyncio.to_thread(
+                        batch.verify_beacons, self._info.public_key, chunk)
                 stored = 0
                 for b, ok in zip(chunk, oks):
                     if not ok:

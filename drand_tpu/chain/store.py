@@ -16,6 +16,7 @@ import sqlite3
 import threading
 from typing import Callable, Iterator
 
+from ..utils.aio import spawn
 from .beacon import Beacon
 from .info import Info
 
@@ -318,4 +319,4 @@ class CallbackStore(WrappedStore):
         for cb in cbs:
             res = cb(b)
             if asyncio.iscoroutine(res):
-                asyncio.ensure_future(res)
+                spawn(res)

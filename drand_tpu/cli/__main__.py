@@ -540,6 +540,30 @@ def cmd_util(args) -> None:
     asyncio.run(run())
 
 
+def cmd_analyze(args) -> None:
+    """Static-analysis suite (tools/analyze): loopblock, secretflow,
+    jaxhazard, asyncsanity plus the metrics catalogue lint — pure AST,
+    host-only, no backend init. Exit 1 on unsuppressed findings at or
+    above --fail-on."""
+    import pathlib
+
+    repo = pathlib.Path(__file__).resolve().parents[2]
+    if not (repo / "tools" / "analyze" / "run.py").is_file():
+        raise SystemExit("drand analyze needs a source checkout "
+                         "(tools/analyze/ not found next to the package)")
+    sys.path.insert(0, str(repo))
+    from tools.analyze.run import main as analyze_main
+
+    argv = ["--fail-on", args.fail_on]
+    if args.json:
+        argv.append("--json")
+    if args.passes:
+        argv += ["--passes", args.passes]
+    if args.baseline:
+        argv += ["--baseline", args.baseline]
+    raise SystemExit(analyze_main(argv))
+
+
 def cmd_relay(args) -> None:
     """HTTP CDN relay (reference cmd/relay): serve the public API backed by
     the VERIFIED client stack over one or more origin nodes."""
@@ -808,6 +832,20 @@ def main(argv=None) -> None:
                    help="raw JSON instead of the pretty rendering "
                         "(trace/engine)")
     u.set_defaults(fn=cmd_util)
+
+    an = sub.add_parser("analyze",
+                        help="AST static-analysis suite (loopblock, "
+                             "secretflow, jaxhazard, asyncsanity, "
+                             "metrics lint)")
+    an.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    an.add_argument("--fail-on", choices=["high", "medium", "low"],
+                    default="high")
+    an.add_argument("--passes", default="",
+                    help="comma-separated pass subset")
+    an.add_argument("--baseline", default="",
+                    help="override the baseline-suppression file")
+    an.set_defaults(fn=cmd_analyze)
 
     r = sub.add_parser("relay")
     r.add_argument("--url", required=True,

@@ -11,6 +11,7 @@ from __future__ import annotations
 import asyncio
 import time
 
+from ..utils.aio import spawn
 from ..utils.logging import KVLogger, default_logger
 from .interface import Client, ClientError, Result
 
@@ -84,7 +85,7 @@ class OptimizingClient(Client):
                 len(self._sources) == 1:
             return
         self._last_ranked = now
-        asyncio.ensure_future(self._rank())
+        spawn(self._rank())
 
     async def _rank(self) -> None:
         from .. import metrics

@@ -8,18 +8,23 @@ ephemeral key. Neither side learns anything from transit observation.
 
 from __future__ import annotations
 
+import asyncio
+
 from ..crypto import bls, ecies
 from ..key.keys import Identity
 from .interface import ClientError
 
 
 async def private_rand(client, node_identity: Identity) -> bytes:
-    """Fetch 32 private random bytes from the node over the transport."""
-    eph_sk, eph_pub = bls.keygen()
-    request = ecies.encrypt(node_identity.key, eph_pub.to_bytes())
+    """Fetch 32 private random bytes from the node over the transport.
+    The G1 point work runs off the event loop (loopblock discipline:
+    this client may be embedded in a serving process)."""
+    eph_sk, eph_pub = await asyncio.to_thread(bls.keygen)
+    request = await asyncio.to_thread(
+        ecies.encrypt, node_identity.key, eph_pub.to_bytes())
     reply = await client.private_rand(node_identity, request)
     try:
-        out = ecies.decrypt(eph_sk, reply)
+        out = await asyncio.to_thread(ecies.decrypt, eph_sk, reply)
     except Exception as e:  # noqa: BLE001
         raise ClientError(f"private rand: bad reply: {e!r}") from e
     if len(out) != 32:

@@ -78,7 +78,11 @@ class VerifyingClient(Client):
             # unchained era: the V2 signature alone proves the round
             if not b.signature_v2:
                 raise ClientError(f"round {r.round}: missing V2 signature")
-            if not chain_beacon.verify_beacon_v2(info.public_key, b):
+            # pairings run on a worker thread: a client embedded in a
+            # serving process (relay, gossip node) must not stall its
+            # event loop for per-round verification
+            if not await asyncio.to_thread(chain_beacon.verify_beacon_v2,
+                                           info.public_key, b):
                 raise ClientError(f"round {r.round}: invalid V2 signature")
             return self._finish(r)
         if self._strict:
@@ -87,9 +91,7 @@ class VerifyingClient(Client):
                 raise ClientError(
                     f"round {r.round}: previous signature does not chain "
                     f"to the trusted history")
-        ok = chain_beacon.verify_beacon(info.public_key, b)
-        if ok and b.is_v2():
-            ok = chain_beacon.verify_beacon_v2(info.public_key, b)
+        ok = await asyncio.to_thread(self._check_sigs, info.public_key, b)
         if not ok:
             raise ClientError(f"round {r.round}: invalid signature")
         if self._strict:
@@ -97,6 +99,14 @@ class VerifyingClient(Client):
                 if self._trust is None or r.round > self._trust[0]:
                     self._trust = (r.round, r.signature)
         return self._finish(r)
+
+    @staticmethod
+    def _check_sigs(pubkey, b: Beacon) -> bool:
+        """Dual V1(+V2) pairing check, shaped for ``asyncio.to_thread``."""
+        ok = chain_beacon.verify_beacon(pubkey, b)
+        if ok and b.is_v2():
+            ok = chain_beacon.verify_beacon_v2(pubkey, b)
+        return ok
 
     @staticmethod
     def _finish(r: Result) -> Result:
@@ -127,7 +137,10 @@ class VerifyingClient(Client):
                         raise ClientError(
                             f"round {b.round}: broken signature chain")
                     prev = b.signature
-                oks = batch.verify_beacons(info.public_key, beacons)
+                # the chunk's multi-pairing span runs off the loop —
+                # catch-up walks can be thousands of rounds long
+                oks = await asyncio.to_thread(
+                    batch.verify_beacons, info.public_key, beacons)
                 if not oks.all():
                     bad = beacons[int((~oks).argmax())]
                     raise ClientError(

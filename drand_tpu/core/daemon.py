@@ -24,6 +24,7 @@ from ..dkg import BroadcastBoard, DKGConfig, DKGError, DKGProtocol, DistKeyShare
 from ..key.group import Group
 from ..key.keys import Node, Pair, Share
 from ..key.store import FileStore
+from ..utils.aio import spawn
 from ..net.packets import (
     GroupPacket,
     PartialBeaconPacket,
@@ -257,9 +258,9 @@ class Drand(ProtocolService):
         HEALTH.note_dkg_complete()
         self._make_handler(group, share)
         if catchup:
-            asyncio.ensure_future(self.beacon.catchup())
+            spawn(self.beacon.catchup())
         else:
-            asyncio.ensure_future(self.beacon.start())
+            spawn(self.beacon.start())
 
     def stop(self) -> None:
         self._stopped = True
@@ -416,7 +417,7 @@ class Drand(ProtocolService):
             self.store.save_group(group)
             self.store.save_share(self.share)
         self._make_handler(group, self.share)
-        asyncio.ensure_future(self.beacon.start())
+        spawn(self.beacon.start())
         from ..obs.health import HEALTH
 
         HEALTH.note_dkg_complete()
@@ -438,8 +439,7 @@ class Drand(ProtocolService):
         if not is_member:
             # leaving: stop right before the transition round fires
             if self.beacon is not None:
-                asyncio.ensure_future(
-                    self.beacon.stop_at(new_group.transition_time - 1))
+                spawn(self.beacon.stop_at(new_group.transition_time - 1))
             self._l.info("reshare", "leaving_at",
                          t=new_group.transition_time)
             self.group = new_group
@@ -450,7 +450,7 @@ class Drand(ProtocolService):
             self.beacon.transition_new_group(new_share, new_group)
         else:
             self._make_handler(new_group, new_share)
-            asyncio.ensure_future(self.beacon.transition(old_group))
+            spawn(self.beacon.transition(old_group))
         self.group, self.share = new_group, new_share
         return new_group
 
@@ -553,12 +553,21 @@ class Drand(ProtocolService):
         from ..crypto.curves import PointG1
         from ..utils import entropy
 
+        # the whole exchange off the loop: ECIES point muls AND the
+        # entropy read (a configured entropy source is a subprocess
+        # wait) — this is public ingress on the same loop that drives
+        # the beacon round
+        def _decode(raw: bytes) -> PointG1:
+            return PointG1.from_bytes(ecies.decrypt(self.priv.key, raw))
+
+        def _reply(key: PointG1) -> bytes:
+            return ecies.encrypt(key, entropy.get_random(32))
+
         try:
-            client_key = PointG1.from_bytes(
-                ecies.decrypt(self.priv.key, bytes(request)))
+            client_key = await asyncio.to_thread(_decode, bytes(request))
         except Exception as e:  # noqa: BLE001 — untrusted ingress
             raise TransportError(f"private rand: bad request: {e!r}") from e
-        return ecies.encrypt(client_key, entropy.get_random(32))
+        return await asyncio.to_thread(_reply, client_key)
 
     async def signal_dkg_participant(self, from_addr: str,
                                      packet: SignalDKGPacket) -> None:

@@ -43,6 +43,11 @@ from .poly import PubPoly
 _MODE = os.environ.get("DRAND_TPU_ENGINE", "auto")
 _MIN_BATCH = int(os.environ.get("DRAND_TPU_MIN_BATCH", "8"))
 _ENGINE = None
+# engine() is now reachable from several asyncio.to_thread workers at
+# once (aggregator, sync verify, client catch-up) — the lazy singleton
+# init must not construct two BatchedEngines (duplicate jit setup,
+# discarded KAT verdicts)
+_ENGINE_LOCK = threading.Lock()
 _FALLBACK_LOGGED = False
 
 # Bounded fallback ledger (ISSUE 6 engine introspection): the last N
@@ -229,8 +234,14 @@ def engine():
     kicked onto a background thread and this call raises
     ``BackendUnavailable`` — the dispatch wrappers fall back to host
     crypto until the probe lands (the daemon warms it at startup, so in
-    practice only the first post-boot rounds are affected). Synchronous
-    callers (bench, CLI one-shots) block on the probe once."""
+    practice only the first post-boot rounds are affected). The daemon's
+    ``asyncio.to_thread`` workers (aggregator, sync verify, client
+    catch-up) count as loop callers: they serve round-deadline work, so
+    a tunnel-down probe must not park them for ~90 s — they are
+    recognized by the default executor's ``asyncio_`` thread-name
+    prefix (CPython names it in ``run_in_executor``). Only true
+    synchronous callers (bench, CLI one-shots) block on the probe
+    once."""
     global _ENGINE
     if _MODE == "host":
         return None
@@ -244,10 +255,11 @@ def engine():
         if st is None:
             try:
                 asyncio.get_running_loop()
-                in_loop = True
+                nonblocking = True
             except RuntimeError:
-                in_loop = False
-            if in_loop:
+                nonblocking = threading.current_thread().name.startswith(
+                    "asyncio_")
+            if nonblocking:
                 probe_backend_bg()
                 raise BackendUnavailable(
                     "jax backend probe in progress — host crypto fallback "
@@ -259,7 +271,9 @@ def engine():
                 "fallback in effect for this process")
         from ..ops.engine import BatchedEngine
 
-        _ENGINE = BatchedEngine()
+        with _ENGINE_LOCK:
+            if _ENGINE is None:
+                _ENGINE = BatchedEngine()
     return _ENGINE
 
 

@@ -14,6 +14,7 @@ import asyncio
 
 from ..crypto import schnorr
 from ..key.keys import Node
+from ..utils.aio import spawn
 from ..utils.logging import KVLogger
 from .packets import DealBundle, JustificationBundle, ResponseBundle
 
@@ -141,7 +142,7 @@ class BroadcastBoard(Board):
             self.justifications.put_nowait(bundle)
         if rebroadcast:
             for peer in self._peers.values():
-                asyncio.ensure_future(self._send(peer, bundle))
+                spawn(self._send(peer, bundle))
 
     async def _send(self, peer: Node, bundle) -> None:
         try:

@@ -133,6 +133,14 @@ class DKGProtocol:
         deals = await self._collect(
             self.board.deals, expect=len(dealers),
             issuer=lambda b: b.dealer_index)
+        # deliberately INLINE (loopblock baseline entry): deal admission
+        # is a batched commitment evaluation + point muls, but the DKG
+        # runs in a dedicated phase-clock-driven setup window — an
+        # executor hand-off here suspends the node between a phase
+        # deadline and its response push, and a concurrently advancing
+        # clock (FakeClock tests; aggressive operator timeouts) can
+        # close the response window while the thread runs. Bounded: one
+        # batched eval per DKG, not per round.
         self._process_deals(deals)
 
         if self._share_index is not None:

@@ -49,6 +49,7 @@ from ..chain.info import Info
 from ..client.interface import Client, ClientError, result_from_beacon
 from ..net import wire
 from ..obs import trace as obs_trace
+from ..utils.aio import spawn
 from ..utils.clock import Clock, SystemClock
 from ..utils.logging import KVLogger, default_logger
 
@@ -142,6 +143,14 @@ class GossipNode(Client):
         self._peers: dict[str, _PeerState] = {}
         self._ip_scores: dict[str, _IpScore] = {}
         self._seen: dict[bytes, None] = {}  # insertion-ordered for FIFO evict
+        # msg_ids whose validation is in flight: the to_thread hand-off
+        # in _accept_beacon suspends between the _seen check and the
+        # _seen insert, so without this guard N concurrent deliveries
+        # of one flooded beacon would all validate and all re-flood.
+        # value = {"round", "max_live" (the running validation's clock
+        # snapshot), "retry" (a duplicate saw a fresher clock admit the
+        # round — revalidate before giving up)}
+        self._inflight: dict[bytes, dict] = {}
         self._cache: dict[int, Beacon] = {}
         self._cache_rounds = cache_rounds
         self._tip = 0
@@ -178,7 +187,7 @@ class GossipNode(Client):
         st.banned_until = self._clock.now() + EVICT_COOLOFF
         st.fails = 0
         if st.channel is not None:
-            asyncio.ensure_future(st.channel.close())
+            spawn(st.channel.close())
             st.channel = None
         self._l.warn("gossip", "peer_evicted", peer=addr, why=why,
                      cooloff_s=EVICT_COOLOFF)
@@ -262,6 +271,22 @@ class GossipNode(Client):
         msg_id = hashlib.blake2b(raw, digest_size=16).digest()
         if msg_id in self._seen:
             return
+        entry = self._inflight.get(msg_id)
+        if entry is not None:
+            # same bytes, so the SIGNATURE half of the running
+            # validation's verdict transfers — but the liveness bound is
+            # snapshotted from the clock at arrival, and the round
+            # boundary can cross mid-validation (the pairing runs on a
+            # worker thread). If the running bound already admits the
+            # round, or the round is still future by OUR clock, the
+            # duplicate's verdict would match: drop it. Otherwise ask
+            # the running call to revalidate with a fresh bound — the
+            # flooded copies are this relay's only chance at the round
+            # (peers mark the message seen and will not re-send)
+            if entry["round"] > entry["max_live"] \
+                    and entry["round"] <= self._max_live_round():
+                entry["retry"] = True
+            return
         msg, _ = wire.decode(raw)
         if not isinstance(msg, Beacon):
             raise wire.WireError("gossip: not a beacon")
@@ -272,12 +297,33 @@ class GossipNode(Client):
         # _tip starts at 0 on a fresh relay, and an ascending replay
         # would keep it one round behind the burst
         max_live = self._max_live_round()
-        ring_lo = max(self._tip, max_live - obs_trace.TRACER.max_rounds)
-        with obs_trace.TRACER.activate(
-                round_no=msg.round, chain=self.chain_info.genesis_seed,
-                retain=ring_lo <= msg.round <= max_live):
-            return await self._accept_beacon(msg, msg_id, raw, validate,
-                                             sender, max_live)
+        entry = {"round": msg.round, "max_live": max_live, "retry": False}
+        self._inflight[msg_id] = entry
+        try:
+            while True:
+                ring_lo = max(self._tip,
+                              max_live - obs_trace.TRACER.max_rounds)
+                with obs_trace.TRACER.activate(
+                        round_no=msg.round,
+                        chain=self.chain_info.genesis_seed,
+                        retain=ring_lo <= msg.round <= max_live):
+                    await self._accept_beacon(msg, msg_id, raw, validate,
+                                              sender, max_live)
+                if msg_id in self._seen or not entry["retry"]:
+                    return
+                # a duplicate arrived after the boundary crossed: its
+                # clock admits the round our snapshot rejected. One
+                # retry per crossing — the bound is strictly larger
+                max_live = self._max_live_round()
+                if msg.round > max_live:
+                    return
+                entry["max_live"] = max_live
+                entry["retry"] = False
+                # the sender took its invalid strike on the first pass;
+                # a retry failure must not charge the same delivery twice
+                sender = ""
+        finally:
+            self._inflight.pop(msg_id, None)
 
     async def _accept_beacon(self, msg: Beacon, msg_id: bytes, raw: bytes,
                              validate: bool, sender: str,
@@ -293,7 +339,10 @@ class GossipNode(Client):
                 key=_SENDER_TAG_KEY).hexdigest()
             with obs_trace.TRACER.span("gossip_validate", sender=sender_tag,
                                        v2=msg.is_v2()) as sp:
-                ok = self._validate(msg, max_live)
+                # pairings off the loop: a mesh node validates every
+                # flooded beacon, and the same loop serves the pubsub
+                # streams and /healthz
+                ok = await asyncio.to_thread(self._validate, msg, max_live)
                 sp.attrs["ok"] = ok
         else:
             ok = True
@@ -319,7 +368,7 @@ class GossipNode(Client):
                 pass
         for addr, st in self._peers.items():
             if self._live_channel(addr, st) is not None:
-                asyncio.ensure_future(self._forward(addr, st, raw))
+                spawn(self._forward(addr, st, raw))
 
     async def _forward(self, addr: str, st: _PeerState, raw: bytes) -> None:
         ch = st.channel

@@ -1,0 +1,60 @@
+"""Event-loop task discipline: the one sanctioned fire-and-forget entry.
+
+``asyncio`` keeps only a WEAK reference to scheduled tasks — a bare
+``asyncio.ensure_future(coro())`` whose return value is dropped can be
+garbage-collected mid-flight, silently cancelling the work (the exact
+bug PR 6 fixed by hand in the OTLP exporter). :func:`spawn` parks every
+task in a module-level registry until it completes, so a background
+task lives exactly as long as its coroutine, and logs any exception
+that would otherwise vanish with the task object.
+
+``tools/analyze``'s asyncsanity pass enforces this mechanically: a
+discarded ``create_task``/``ensure_future`` result anywhere under
+``drand_tpu/`` is a finding; routing the call through ``spawn`` is the
+fix.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable
+
+_TASKS: set[asyncio.Future] = set()
+
+
+def _on_done(task: asyncio.Future) -> None:
+    _TASKS.discard(task)
+    if task.cancelled():
+        return
+    exc = task.exception()
+    if exc is None:
+        return
+    # a fire-and-forget task's exception has no awaiter to surface it;
+    # without this hook it only appears at GC time (or never)
+    from .logging import default_logger
+
+    name = task.get_name() if hasattr(task, "get_name") else "task"
+    default_logger("aio").error("spawn", "task_failed", task=name,
+                                err=repr(exc))
+
+
+def spawn(coro: Awaitable, *, name: str | None = None) -> asyncio.Future:
+    """Schedule ``coro`` as a background task with a STRONG reference
+    held until completion. Returns the task (callers may still await or
+    cancel it; most drop it, which is the point)."""
+    task = asyncio.ensure_future(coro)
+    if name is not None and hasattr(task, "set_name"):
+        task.set_name(name)
+    # a task whose loop closed before it finished never runs _on_done;
+    # keeping it here would pin its coroutine frame for the process
+    # lifetime AND mute the destroyed-pending-task GC warning
+    for t in [t for t in _TASKS if t.get_loop().is_closed()]:
+        _TASKS.discard(t)
+    _TASKS.add(task)
+    task.add_done_callback(_on_done)
+    return task
+
+
+def pending_tasks() -> int:
+    """How many spawned tasks are still in flight (introspection/tests)."""
+    return len(_TASKS)

@@ -12,8 +12,10 @@ The reference has no analogue (a Go binary has no remote device to
 lose); this is axon-environment hardening.
 """
 
+import asyncio
 import subprocess
 import sys
+import threading
 import time
 import types
 
@@ -81,3 +83,60 @@ print("UNREACHABLE", flush=True)
     assert "FINAL-LINE" in proc.stdout
     assert "UNREACHABLE" not in proc.stdout
     assert dt < 30, f"watchdog too slow: {dt:.1f}s"
+
+
+@pytest.mark.asyncio
+async def test_engine_probe_nonblocking_from_to_thread(monkeypatch):
+    """crypto/batch.engine() must treat asyncio.to_thread workers like
+    event-loop callers: with no probe verdict yet it kicks the
+    background probe and raises BackendUnavailable (host fallback)
+    instead of joining the synchronous ~90 s probe — the daemon's
+    aggregator/sync/catch-up workers serve round-deadline work."""
+    from drand_tpu.crypto import batch
+
+    monkeypatch.setattr(batch, "_MODE", "auto")
+    monkeypatch.setattr(batch, "_ENGINE", None)
+    monkeypatch.setattr(B, "backend_already_up", lambda: False)
+    monkeypatch.setattr(B, "probe_state", lambda: None)
+    kicked = []
+    monkeypatch.setattr(B, "probe_backend_bg",
+                        lambda *a, **k: kicked.append(1))
+
+    def must_not_block(*a, **k):
+        raise AssertionError("synchronous probe joined from a "
+                             "to_thread worker")
+
+    monkeypatch.setattr(B, "probe_backend", must_not_block)
+    with pytest.raises(B.BackendUnavailable):
+        await asyncio.to_thread(batch.engine)
+    assert kicked
+
+
+def test_engine_singleton_construction_is_locked(monkeypatch):
+    """Two worker threads racing the lazy _ENGINE init must construct
+    exactly one engine (duplicate BatchedEngine = duplicate jit setup
+    and a discarded KAT-verdict cache)."""
+    from drand_tpu.crypto import batch
+    from drand_tpu.ops import engine as ops_engine
+
+    monkeypatch.setattr(batch, "_MODE", "auto")
+    monkeypatch.setattr(batch, "_ENGINE", None)
+    monkeypatch.setattr(B, "probe_state", lambda: True)
+
+    built = []
+
+    class FakeEngine:
+        def __init__(self):
+            built.append(self)
+            time.sleep(0.1)  # widen the race window
+
+    monkeypatch.setattr(ops_engine, "BatchedEngine", FakeEngine)
+    results = []
+    threads = [threading.Thread(target=lambda: results.append(
+        batch.engine())) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(built) == 1
+    assert results[0] is results[1] is built[0]

@@ -209,3 +209,94 @@ async def test_ip_ban_cross_check_ipv6_and_hostname(monkeypatch):
     finally:
         gmod._resolve_host.cache_clear()
         await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_concurrent_duplicate_publish_validates_once():
+    """Validation runs on a worker thread (asyncio.to_thread), which
+    opens a suspension point between the _seen dedup check and the
+    _seen insert. The in-flight guard must collapse N concurrent
+    deliveries of the same flooded beacon to ONE validation and ONE
+    subscriber wakeup — without it every duplicate re-validates and
+    re-floods (per-message amplification at every round boundary)."""
+    import time as _time
+
+    from drand_tpu.net import wire
+
+    mock = MockBeaconServer(nrounds=3)
+    clock = FakeClock(start=mock.chain_info.genesis_time + 1000)
+    node = GossipNode(mock.chain_info, clock=clock)
+    calls = 0
+    real_validate = node._validate
+
+    def counting_validate(b, max_live=None):
+        nonlocal calls
+        calls += 1
+        _time.sleep(0.15)  # hold the worker thread so duplicates overlap
+        return real_validate(b, max_live)
+
+    node._validate = counting_validate
+    q: asyncio.Queue = asyncio.Queue()
+    node._subs.append(q)
+    raw = wire.encode(mock.beacons[1])
+    await asyncio.gather(*(node._accept(raw, validate=True)
+                           for _ in range(5)))
+    assert calls == 1
+    assert q.qsize() == 1
+    assert node._tip == 1
+
+    # post-validation re-delivery is the ordinary _seen no-op
+    await node._accept(raw, validate=True)
+    assert calls == 1
+    assert q.qsize() == 1
+
+
+@pytest.mark.asyncio
+async def test_boundary_crossing_duplicate_forces_revalidation():
+    """The liveness half of a validation verdict is a clock snapshot,
+    not a property of the bytes: when the first flooded copy of round N
+    arrives a moment before N's boundary, its validation rejects
+    (far-future) — and every concurrent duplicate arrives AFTER the
+    boundary, when the round is live. Peers mark the message seen and
+    never re-send, so silently dropping those duplicates loses the
+    round until catch-up. The in-flight guard must instead note the
+    fresher clock and revalidate once with the new bound."""
+    import threading
+
+    from drand_tpu.net import wire
+
+    mock = MockBeaconServer(nrounds=3)
+    period = mock.chain_info.period
+    # mid round 1: max_live = 2, so round 3 is one boundary in the future
+    clock = FakeClock(start=mock.chain_info.genesis_time + period // 2)
+    node = GossipNode(mock.chain_info, clock=clock)
+    started = threading.Event()
+    release = threading.Event()
+    bounds = []
+    real_validate = node._validate
+
+    def gated_validate(b, max_live=None):
+        bounds.append(max_live)
+        started.set()
+        release.wait(5)  # hold the worker thread across the boundary
+        return real_validate(b, max_live)
+
+    node._validate = gated_validate
+    q: asyncio.Queue = asyncio.Queue()
+    node._subs.append(q)
+    raw = wire.encode(mock.beacons[3])
+    first = asyncio.create_task(node._accept(raw, validate=True))
+    await asyncio.to_thread(started.wait, 5)
+    # the boundary crosses while validation is in flight; the flooded
+    # duplicate sees a clock that admits round 3
+    await clock.advance(period)
+    await node._accept(raw, validate=True)  # in-flight duplicate
+    release.set()
+    await first
+    # stale bound rejected, the duplicate's clock forced ONE retry with
+    # the fresh bound, and the beacon landed
+    assert bounds == [2, 3]
+    assert node._tip == 3
+    assert q.qsize() == 1
+    # and the retry did not leak the in-flight entry
+    assert node._inflight == {}

@@ -1,0 +1,23 @@
+"""drand-tpu static analysis suite (`drand analyze` / tools/analyze/run.py).
+
+Pure-AST — never imports the analyzed code, never initializes a jax
+backend — so the whole suite is host-only and fast enough to gate every
+PR from tier-1. Five passes:
+
+- ``loopblock``   blocking work (pairings, engine dispatch, sqlite,
+                  ``time.sleep``, sync sockets) reachable from an
+                  ``async def`` without an executor hand-off
+- ``secretflow``  secret material flowing into logs, metric labels,
+                  exception strings or trace-span attributes
+- ``jaxhazard``   Python control flow on tracers, float dtypes in limb
+                  math, host transfers and re-jitting inside hot paths
+- ``asyncsanity`` un-awaited coroutines and fire-and-forget tasks
+                  without a strong reference
+- ``metrics``     the tools/check_metrics.py catalogue lint, folded in
+                  so tier-1 has one analysis entry point
+
+See README "Static analysis" for usage and the baseline workflow.
+"""
+
+from .core import Finding, Project, SEV_RANK  # noqa: F401
+from .run import run_analysis  # noqa: F401

@@ -1,0 +1,96 @@
+"""asyncsanity: coroutine and task lifecycle discipline.
+
+- ``unawaited-coroutine`` (high): a bare expression statement calling a
+  project ``async def`` — the coroutine object is created, never
+  scheduled, and the work silently never happens ("coroutine was never
+  awaited" only shows up as a GC-time warning, if ever).
+- ``task-without-ref`` (medium): ``asyncio.create_task`` /
+  ``ensure_future`` / ``loop.create_task`` whose result is discarded.
+  The event loop holds tasks WEAKLY — a GC pass can cancel the work
+  mid-flight (the PR-6 exporter bug, now caught mechanically). The fix
+  is ``drand_tpu.utils.aio.spawn``, which parks a strong reference
+  until the task completes; calls resolving to it are exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Project
+
+DEFAULT_SAFE_SPAWNERS = ("drand_tpu.utils.aio.spawn",)
+_TASK_MAKERS = {"create_task", "ensure_future"}
+
+
+def run(project: Project,
+        safe_spawners: tuple[str, ...] = DEFAULT_SAFE_SPAWNERS,
+        ) -> list[Finding]:
+    findings: list[Finding] = []
+    safe_basenames = {s.rsplit(".", 1)[-1] for s in safe_spawners}
+
+    for fn in project.iter_functions():
+        body_stmts = []
+        skip = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+
+        def collect(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, skip):
+                    continue
+                if isinstance(child, ast.Expr):
+                    body_stmts.append(child)
+                collect(child)
+
+        for stmt in fn.node.body:
+            if isinstance(stmt, skip):
+                continue
+            if isinstance(stmt, ast.Expr):
+                body_stmts.append(stmt)
+            collect(stmt)
+
+        for stmt in body_stmts:
+            call = stmt.value
+            if not isinstance(call, ast.Call):
+                continue
+            target, attr = _resolve(fn, call)
+            if target in safe_spawners or (
+                    target is None and attr in safe_basenames):
+                continue
+            if target in project.functions \
+                    and project.functions[target].is_async:
+                findings.append(Finding(
+                    pass_name="asyncsanity", rule="unawaited-coroutine",
+                    severity="high", path=fn.module.relpath,
+                    line=call.lineno, symbol=fn.qualname,
+                    message=(f"`{attr}(...)` is an async def but the "
+                             f"coroutine is neither awaited nor "
+                             f"scheduled in `{fn.qualname}` — the call "
+                             f"silently does nothing")))
+            elif attr in _TASK_MAKERS:
+                findings.append(Finding(
+                    pass_name="asyncsanity", rule="task-without-ref",
+                    severity="medium", path=fn.module.relpath,
+                    line=call.lineno, symbol=fn.qualname,
+                    message=(f"fire-and-forget `{attr}(...)` discards the "
+                             f"task reference in `{fn.qualname}` — the "
+                             f"loop holds tasks weakly and GC can cancel "
+                             f"it mid-flight; use "
+                             f"drand_tpu.utils.aio.spawn")))
+    findings.sort(key=lambda f: (f.path, f.line))
+    return findings
+
+
+def _resolve(fn, call: ast.Call):
+    """(resolved dotted target or None, bare callee name)."""
+    for cs in fn.calls:
+        if cs.line == call.lineno and isinstance(call.func, (ast.Name,
+                                                             ast.Attribute)):
+            name = (call.func.id if isinstance(call.func, ast.Name)
+                    else call.func.attr)
+            if cs.attr == name:
+                return cs.target, cs.attr
+    # fallback: resolve in place
+    if isinstance(call.func, ast.Name):
+        return fn.module.imports.get(call.func.id), call.func.id
+    if isinstance(call.func, ast.Attribute):
+        return None, call.func.attr
+    return None, "<dynamic>"

@@ -1,0 +1,304 @@
+"""Shared AST infrastructure: findings, module/import resolution, the
+intra-project call graph every pass walks.
+
+Resolution is deliberately conservative — a call target that cannot be
+traced to a project function or an imported module is recorded with its
+bare attribute name only, and passes match those against small curated
+lists. False negatives are possible (dynamic dispatch, attributes of
+attributes); false positives are what the passes are tuned against,
+because a lint nobody trusts is a lint nobody runs.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from dataclasses import dataclass, field
+
+SEVERITIES = ("high", "medium", "low", "info")
+SEV_RANK = {s: i for i, s in enumerate(reversed(SEVERITIES))}
+
+
+@dataclass
+class Finding:
+    """One analyzer result. ``key`` is line-number-free so baseline
+    entries survive unrelated edits to the same file. Passes that can
+    report *different* hazards under one (rule, symbol) — loopblock
+    emits one finding per async def, naming the strongest leaf — set
+    ``detail`` so a baseline entry suppresses only the reviewed hazard:
+    a new leaf reached by the same function produces a new key."""
+
+    pass_name: str
+    rule: str
+    severity: str
+    path: str        # repo-relative, forward slashes
+    line: int
+    symbol: str      # qualified function/module symbol the finding anchors to
+    message: str
+    detail: str = ""  # extra key component scoping baseline suppression
+
+    @property
+    def key(self) -> str:
+        base = f"{self.pass_name}:{self.rule}:{self.path}:{self.symbol}"
+        return f"{base}:{self.detail}" if self.detail else base
+
+    def to_dict(self) -> dict:
+        return {
+            "pass": self.pass_name, "rule": self.rule,
+            "severity": self.severity, "path": self.path,
+            "line": self.line, "symbol": self.symbol,
+            "message": self.message, "key": self.key,
+        }
+
+    def render(self) -> str:
+        return (f"[{self.severity:<6}] {self.path}:{self.line} "
+                f"{self.symbol}\n    {self.message}\n    key: {self.key}")
+
+
+@dataclass
+class CallSite:
+    """One call expression inside a function body."""
+
+    target: str | None   # resolved dotted target ("time.sleep", project qualname) or None
+    attr: str            # bare callee name (attribute or identifier)
+    line: int
+    text: str            # dotted rendering for messages ("self._store.put")
+
+
+@dataclass
+class FuncInfo:
+    qualname: str
+    module: "Module"
+    node: ast.AST
+    is_async: bool
+    line: int
+    cls: str | None = None           # enclosing class qualname
+    calls: list[CallSite] = field(default_factory=list)
+
+
+@dataclass
+class Module:
+    name: str
+    path: pathlib.Path
+    relpath: str
+    tree: ast.Module
+    imports: dict[str, str] = field(default_factory=dict)
+
+
+def _dotted(expr: ast.AST) -> list[str] | None:
+    """["a", "b", "c"] for a plain a.b.c chain, else None."""
+    parts: list[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return list(reversed(parts))
+    return None
+
+
+def _resolve_relative(module_name: str, is_package: bool,
+                      target: str | None, level: int) -> str:
+    if level == 0:
+        return target or ""
+    parts = module_name.split(".")
+    if not is_package:
+        parts = parts[:-1]
+    if level > 1:
+        parts = parts[: len(parts) - (level - 1)]
+    return ".".join(parts + ([target] if target else []))
+
+
+class Project:
+    """Parsed view of a Python tree rooted at ``root``.
+
+    ``packages`` restricts the walk (e.g. ``("drand_tpu",)`` for the
+    repo); None walks every ``*.py`` under root — what the fixture
+    tests use.
+    """
+
+    def __init__(self, root: str | pathlib.Path,
+                 packages: tuple[str, ...] | None = None):
+        self.root = pathlib.Path(root).resolve()
+        self.modules: dict[str, Module] = {}
+        self.functions: dict[str, FuncInfo] = {}
+        # class qualname -> (method name -> qualname, base exprs, module)
+        self._classes: dict[str, tuple[dict[str, str], list[str],
+                                       "Module"]] = {}
+        roots = ([self.root / p for p in packages] if packages
+                 else [self.root])
+        files: list[pathlib.Path] = []
+        for r in roots:
+            if r.is_file():
+                files.append(r)
+            else:
+                files.extend(p for p in sorted(r.rglob("*.py"))
+                             if "__pycache__" not in p.parts)
+        for path in files:
+            self._load(path)
+        for mod in self.modules.values():
+            self._index_module(mod)
+        for fn in self.functions.values():
+            self._extract_calls(fn)
+
+    # ------------------------------------------------------------ loading
+    def _module_name(self, path: pathlib.Path) -> str:
+        rel = path.relative_to(self.root)
+        parts = list(rel.parts)
+        parts[-1] = parts[-1][:-3]  # strip .py
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts) if parts else rel.stem
+
+    def _load(self, path: pathlib.Path) -> None:
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError:
+            return  # not this tool's job; the test suite will scream
+        name = self._module_name(path)
+        rel = str(path.relative_to(self.root)).replace("\\", "/")
+        mod = Module(name=name, path=path, relpath=rel, tree=tree)
+        is_pkg = path.name == "__init__.py"
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        mod.imports[alias.asname] = alias.name
+                    else:
+                        top = alias.name.split(".")[0]
+                        mod.imports[top] = top
+            elif isinstance(node, ast.ImportFrom):
+                base = _resolve_relative(name, is_pkg, node.module,
+                                         node.level)
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    mod.imports[local] = (f"{base}.{alias.name}" if base
+                                          else alias.name)
+        self.modules[name] = mod
+
+    # ----------------------------------------------------------- indexing
+    def _index_module(self, mod: Module) -> None:
+        def index(body, scope: str, cls: str | None) -> None:
+            for node in body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qn = f"{scope}.{node.name}"
+                    self.functions[qn] = FuncInfo(
+                        qualname=qn, module=mod, node=node,
+                        is_async=isinstance(node, ast.AsyncFunctionDef),
+                        line=node.lineno, cls=cls)
+                    index(node.body, qn, None)
+                elif isinstance(node, ast.ClassDef):
+                    cqn = f"{scope}.{node.name}"
+                    bases = []
+                    for b in node.bases:
+                        d = _dotted(b)
+                        if d:
+                            bases.append(".".join(d))
+                    self._classes[cqn] = ({}, bases, mod)
+                    index(node.body, cqn, cqn)
+                    methods = {
+                        n.name: f"{cqn}.{n.name}" for n in node.body
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))}
+                    self._classes[cqn] = (methods, bases, mod)
+
+        index(mod.tree.body, mod.name, None)
+
+    def _resolve_class(self, mod: Module, name: str) -> str | None:
+        """A base-class expression to a project class qualname."""
+        for cand in (f"{mod.name}.{name}", mod.imports.get(name, ""),
+                     mod.imports.get(name.split(".")[0], "")):
+            if cand and cand in self._classes:
+                return cand
+        # dotted base via imported module: store.CallbackStore
+        parts = name.split(".")
+        if len(parts) > 1 and parts[0] in mod.imports:
+            cand = ".".join([mod.imports[parts[0]]] + parts[1:])
+            if cand in self._classes:
+                return cand
+        return None
+
+    def _method_lookup(self, mod: Module, cls: str, name: str,
+                       depth: int = 0) -> str | None:
+        if cls not in self._classes or depth > 4:
+            return None
+        methods, bases, defining_mod = self._classes[cls]
+        if name in methods:
+            return methods[name]
+        for b in bases:
+            bq = self._resolve_class(defining_mod, b)
+            if bq:
+                hit = self._method_lookup(mod, bq, name, depth + 1)
+                if hit:
+                    return hit
+        return None
+
+    # ------------------------------------------------------ call extraction
+    def _extract_calls(self, fn: FuncInfo) -> None:
+        mod = fn.module
+
+        def resolve(call: ast.Call) -> CallSite:
+            func = call.func
+            line = call.lineno
+            if isinstance(func, ast.Name):
+                n = func.id
+                for cand in (f"{fn.qualname}.{n}", f"{mod.name}.{n}"):
+                    if cand in self.functions:
+                        return CallSite(cand, n, line, n)
+                if n in mod.imports:
+                    return CallSite(mod.imports[n], n, line, n)
+                return CallSite(None, n, line, n)
+            if isinstance(func, ast.Attribute):
+                parts = _dotted(func)
+                if parts is None:
+                    return CallSite(None, func.attr, line, f"?.{func.attr}")
+                text = ".".join(parts)
+                if parts[0] == "self" and fn.cls and len(parts) == 2:
+                    hit = self._method_lookup(mod, fn.cls, parts[1])
+                    return CallSite(hit, parts[1], line, text)
+                if parts[0] in mod.imports:
+                    base = mod.imports[parts[0]]
+                    fqn = ".".join([base] + parts[1:])
+                    if fqn in self.functions:
+                        return CallSite(fqn, parts[-1], line, text)
+                    # imported module member (time.sleep, jnp.where, ...)
+                    return CallSite(fqn, parts[-1], line, text)
+                if f"{mod.name}.{parts[0]}" in self._classes:
+                    # ClassName.method(...) on a module-local class
+                    hit = self._method_lookup(
+                        mod, f"{mod.name}.{parts[0]}", parts[-1])
+                    return CallSite(hit, parts[-1], line, text)
+                return CallSite(None, parts[-1], line, text)
+            return CallSite(None, "<dynamic>", line, "<dynamic>")
+
+        # Lambda is skipped too: a lambda body runs when the lambda is
+        # CALLED, not where it is written — attributing its calls to the
+        # enclosing function would break loopblock's guarantee that
+        # executor hand-offs neutralize by construction (e.g.
+        # ``await asyncio.to_thread(lambda: batch.verify(...))`` must
+        # not create a call edge from the enclosing async def)
+        skip = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                ast.Lambda)
+
+        def walk(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, skip):
+                    continue
+                if isinstance(child, ast.Call):
+                    fn.calls.append(resolve(child))
+                walk(child)
+
+        # walk the body only: decorators run at def time, not call time,
+        # and nested defs/classes are indexed as their own functions
+        for stmt in fn.node.body:
+            if isinstance(stmt, skip):
+                continue
+            if isinstance(stmt, ast.Call):  # unreachable, Calls are exprs
+                fn.calls.append(resolve(stmt))
+            walk(stmt)
+
+    # ------------------------------------------------------------ helpers
+    def iter_functions(self):
+        return self.functions.values()

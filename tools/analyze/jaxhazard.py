@@ -1,0 +1,309 @@
+"""jaxhazard: JAX-specific correctness and recompile hazards.
+
+Rules:
+
+- ``tracer-branch`` (high): Python ``if``/``while`` on a traced value
+  inside a jitted function — either the condition computes through
+  ``jnp.``/``lax.`` directly, or it references a name assigned from a
+  ``jnp.``/``lax.`` call, or it references a non-static parameter.
+  Tracing either raises ``TracerBoolConversionError`` or silently bakes
+  one branch into the executable.
+- ``float-dtype`` (high): float dtypes inside the limb-arithmetic
+  modules (``ops/``) — 255-bit limb math must stay exact-integer; a
+  float sneaking in is silent precision loss, not an error.
+- ``host-transfer`` (medium): ``np.array``/``np.asarray``/
+  ``jax.device_get``/``device_put``/``.block_until_ready()``/
+  ``int()``/``float()`` over traced values inside a jitted function —
+  a device round-trip per call, invisible in the profile.
+- ``dynamic-shape`` (high): a non-static parameter of a jitted function
+  used in ``range()`` or a shape position — concretization fails at
+  trace time or forces a recompile per distinct value, which is exactly
+  what the ``engine_compile_seconds`` split exists to catch.
+- ``jit-per-call`` (medium): ``jax.jit(f)(...)`` immediately invoked
+  inside a function body — a fresh compile cache (and likely a fresh
+  compile) on every call.
+
+Jit detection covers decorators (``@jit``, ``@jax.jit``,
+``@partial(jax.jit, ...)``) and module-level ``g = jax.jit(f, ...)``
+rebinding of a local function.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, FuncInfo, Project, _dotted
+
+# attribute-position tokens stay narrow: ``limb.double`` is a limb
+# DOUBLING helper, not numpy.double — generic aliases only match as
+# dtype string literals
+_FLOAT_ATTRS = {"float16", "float32", "float64", "bfloat16", "float_"}
+_FLOAT_STRINGS = _FLOAT_ATTRS | {"half", "single", "double", "float"}
+_TRANSFER_CALLS = {"numpy.array", "numpy.asarray", "numpy.frombuffer",
+                   "jax.device_get", "jax.device_put"}
+_JAXY_PREFIXES = ("jax.numpy.", "jax.lax.", "jax.")
+
+
+def _jaxy_name(dotted: str | None) -> bool:
+    return dotted is not None and (
+        dotted.startswith(_JAXY_PREFIXES) or dotted.startswith("jnp.")
+        or dotted.startswith("lax."))
+
+
+def _resolve_dotted(fn: FuncInfo, expr: ast.AST) -> str | None:
+    parts = _dotted(expr)
+    if not parts:
+        return None
+    head = fn.module.imports.get(parts[0], parts[0])
+    return ".".join([head] + parts[1:])
+
+
+def _jit_static_params(fn: FuncInfo) -> tuple[bool, set[str]] | None:
+    """(is_jitted, static param names), or None when not jitted."""
+    node = fn.node
+    decs = getattr(node, "decorator_list", [])
+    for dec in decs:
+        call = dec if isinstance(dec, ast.Call) else None
+        target = call.func if call else dec
+        dotted = _resolve_dotted(fn, target)
+        if dotted is None:
+            continue
+        if dotted.endswith(".jit") or dotted == "jit" \
+                or dotted == "jax.jit":
+            return True, _statics_from_call(fn, call)
+        if dotted.endswith("partial") and call and call.args:
+            inner = _resolve_dotted(fn, call.args[0])
+            if inner and (inner.endswith(".jit") or inner == "jit"):
+                return True, _statics_from_call(fn, call)
+    return None
+
+
+def _statics_from_call(fn: FuncInfo, call: ast.Call | None) -> set[str]:
+    if call is None:
+        return set()
+    # static_argnums indexes the POSITIONAL parameter list, which starts
+    # with positional-only params — args.args alone misaligns them
+    params = [a.arg for a in (fn.node.args.posonlyargs
+                              + fn.node.args.args)]
+    statics: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    statics.add(n.value)
+        elif kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    if 0 <= n.value < len(params):
+                        statics.add(params[n.value])
+    return statics
+
+
+def _module_level_jitted(project: Project) -> dict[str, set[str]]:
+    """qualname -> static names, for ``g = jax.jit(f, ...)`` bindings."""
+    out: dict[str, set[str]] = {}
+    for mod in project.modules.values():
+        for node in mod.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            call = node.value
+            parts = _dotted(call.func)
+            if not parts:
+                continue
+            head = mod.imports.get(parts[0], parts[0])
+            dotted = ".".join([head] + parts[1:])
+            if not (dotted == "jax.jit" or dotted.endswith(".jit")
+                    or dotted == "jit"):
+                continue
+            if not call.args or not isinstance(call.args[0], ast.Name):
+                continue
+            target = f"{mod.name}.{call.args[0].id}"
+            if target in project.functions:
+                fn = project.functions[target]
+                out[target] = _statics_from_call(fn, call)
+    return out
+
+
+def run(project: Project,
+        float_dtype_dirs: tuple[str, ...] = ("ops/",)) -> list[Finding]:
+    findings: list[Finding] = []
+    jitted_extra = _module_level_jitted(project)
+
+    for fn in project.iter_functions():
+        uses_jax = any(v.startswith(("jax", "jnp", "lax"))
+                       for v in fn.module.imports.values())
+        jit = _jit_static_params(fn)
+        statics: set[str] = set()
+        is_jitted = False
+        if jit is not None:
+            is_jitted, statics = jit
+        elif fn.qualname in jitted_extra:
+            is_jitted, statics = True, jitted_extra[fn.qualname]
+        if is_jitted:
+            findings.extend(_scan_jitted(fn, statics))
+        if uses_jax:
+            findings.extend(_scan_jit_per_call(fn))
+    findings.extend(_scan_float_dtypes(project, float_dtype_dirs))
+    findings.sort(key=lambda f: (f.path, f.line))
+    return findings
+
+
+def _scan_jitted(fn: FuncInfo, statics: set[str]) -> list[Finding]:
+    out: list[Finding] = []
+    # positional-only and keyword-only params trace like any other
+    # argument (jax.jit traces kwargs too) — only the statics are exempt
+    params = {a.arg for a in (fn.node.args.posonlyargs + fn.node.args.args
+                              + fn.node.args.kwonlyargs)} \
+        - statics - {"self"}
+
+    # names assigned from jnp./lax. calls are tracer-ish
+    tracerish: set[str] = set(params)
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Assign):
+            src_jaxy = any(
+                isinstance(c, ast.Call) and _jaxy_name(
+                    _resolve_dotted(fn, c.func))
+                for c in ast.walk(node.value))
+            if src_jaxy:
+                for t in node.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            tracerish.add(n.id)
+
+    def refs_tracer(expr: ast.AST) -> str | None:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and n.id in tracerish:
+                return n.id
+            if isinstance(n, ast.Call) and _jaxy_name(
+                    _resolve_dotted(fn, n.func)):
+                return ast.unparse(n.func) if hasattr(ast, "unparse") \
+                    else "jnp call"
+        return None
+
+    for node in ast.walk(fn.node):
+        if isinstance(node, (ast.If, ast.While)):
+            hit = refs_tracer(node.test)
+            if hit:
+                kind = "while" if isinstance(node, ast.While) else "if"
+                out.append(Finding(
+                    pass_name="jaxhazard", rule="tracer-branch",
+                    severity="high", path=fn.module.relpath,
+                    line=node.lineno, symbol=fn.qualname,
+                    message=(f"Python `{kind}` on traced value `{hit}` "
+                             f"inside jitted `{fn.qualname}` — use "
+                             f"lax.cond/select, or mark the value "
+                             f"static")))
+        elif isinstance(node, ast.Call):
+            dotted = _resolve_dotted(fn, node.func)
+            # np.array/asarray on CONSTANTS at trace time is fine (and
+            # idiomatic); only a traced operand means a device sync
+            np_pull = (dotted in _TRANSFER_CALLS
+                       and dotted.startswith("numpy.")
+                       and any(refs_tracer(a) for a in node.args))
+            always = (dotted in _TRANSFER_CALLS
+                      and not dotted.startswith("numpy."))
+            if np_pull or always or (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "block_until_ready"):
+                out.append(Finding(
+                    pass_name="jaxhazard", rule="host-transfer",
+                    severity="medium", path=fn.module.relpath,
+                    line=node.lineno, symbol=fn.qualname,
+                    message=(f"host<->device transfer `{dotted or 'block_until_ready'}` "
+                             f"inside jitted `{fn.qualname}` — hoist out "
+                             f"of the traced path")))
+            elif isinstance(node.func, ast.Name) \
+                    and node.func.id in ("int", "float", "bool") \
+                    and node.args and refs_tracer(node.args[0]):
+                out.append(Finding(
+                    pass_name="jaxhazard", rule="host-transfer",
+                    severity="medium", path=fn.module.relpath,
+                    line=node.lineno, symbol=fn.qualname,
+                    message=(f"`{node.func.id}()` concretizes a traced "
+                             f"value inside jitted `{fn.qualname}` — a "
+                             f"device sync per call")))
+            elif isinstance(node.func, ast.Name) and node.func.id == "range":
+                for arg in node.args:
+                    for n in ast.walk(arg):
+                        if isinstance(n, ast.Name) and n.id in params:
+                            out.append(Finding(
+                                pass_name="jaxhazard", rule="dynamic-shape",
+                                severity="high", path=fn.module.relpath,
+                                line=node.lineno, symbol=fn.qualname,
+                                message=(f"non-static parameter `{n.id}` "
+                                         f"drives `range()` inside jitted "
+                                         f"`{fn.qualname}` — trace-time "
+                                         f"error or recompile per value; "
+                                         f"mark it static or use "
+                                         f"lax.fori_loop")))
+                            break
+            elif dotted and dotted.rsplit(".", 1)[-1] in (
+                    "zeros", "ones", "empty", "full", "arange") \
+                    and _jaxy_name(dotted) and node.args:
+                for n in ast.walk(node.args[0]):
+                    if isinstance(n, ast.Name) and n.id in params:
+                        out.append(Finding(
+                            pass_name="jaxhazard", rule="dynamic-shape",
+                            severity="high", path=fn.module.relpath,
+                            line=node.lineno, symbol=fn.qualname,
+                            message=(f"non-static parameter `{n.id}` used "
+                                     f"as a shape in jitted "
+                                     f"`{fn.qualname}` — shapes must be "
+                                     f"concrete at trace time; mark it "
+                                     f"static (and watch the recompile "
+                                     f"cache key)")))
+                        break
+    return out
+
+
+def _scan_jit_per_call(fn: FuncInfo) -> list[Finding]:
+    out: list[Finding] = []
+    for node in ast.walk(fn.node):
+        if not isinstance(node, ast.Call):
+            continue
+        # jax.jit(f)(args): the OUTER call's func is itself a jit call
+        inner = node.func
+        if isinstance(inner, ast.Call):
+            dotted = _resolve_dotted(fn, inner.func)
+            if dotted and (dotted == "jax.jit" or dotted.endswith(".jit")
+                           or dotted == "jit"):
+                out.append(Finding(
+                    pass_name="jaxhazard", rule="jit-per-call",
+                    severity="medium", path=fn.module.relpath,
+                    line=node.lineno, symbol=fn.qualname,
+                    message=(f"`jit(...)(...)` immediately invoked inside "
+                             f"`{fn.qualname}` — a fresh compile cache "
+                             f"every call; hoist the jitted callable")))
+    return out
+
+
+def _scan_float_dtypes(project: Project,
+                       dirs: tuple[str, ...]) -> list[Finding]:
+    out: list[Finding] = []
+    for mod in project.modules.values():
+        # match whole path components, not substrings: "ops/" must hit
+        # drand_tpu/ops/bl.py but not a future loops/ or drops/ package
+        parents = mod.relpath.split("/")[:-1]
+        if not any(d.strip("/") in parents for d in dirs):
+            continue
+        for node in ast.walk(mod.tree):
+            name = None
+            if isinstance(node, ast.Attribute) \
+                    and node.attr in _FLOAT_ATTRS:
+                name = node.attr
+            elif isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and node.value in _FLOAT_STRINGS:
+                name = node.value
+            if name is None:
+                continue
+            out.append(Finding(
+                pass_name="jaxhazard", rule="float-dtype",
+                severity="high", path=mod.relpath,
+                line=getattr(node, "lineno", 1), symbol=mod.name,
+                message=(f"float dtype `{name}` in limb-math module "
+                         f"`{mod.name}` — 255-bit limb arithmetic must "
+                         f"stay exact-integer (i32 lanes); floats are "
+                         f"silent precision loss")))
+    return out

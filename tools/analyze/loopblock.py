@@ -1,0 +1,160 @@
+"""loopblock: blocking work reachable from ``async def`` bodies.
+
+The Go reference runs its pairing work in goroutines; asyncio gives no
+such free pass — one ``batch.verify_beacons`` on a 1024-round catch-up
+span parks the event loop for seconds, freezing /healthz, gossip and
+DKG. This pass propagates "blocking" taint from known-heavy leaves up
+the intra-project call graph and flags every ``async def`` that can
+reach one without an executor hand-off (``asyncio.to_thread`` /
+``run_in_executor`` — functions passed as *arguments* to those never
+create call edges, so a hand-off neutralizes the path by construction).
+
+Severity is the strongest leaf on the path: pairing-class work
+(pairings, Miller loops, MSM, engine dispatch) is high; bounded
+point-multiplication and sync-I/O-class work (``time.sleep``, sqlite,
+sockets, single scalar muls) is medium.
+"""
+
+from __future__ import annotations
+
+import re
+
+from .core import Finding, Project, SEV_RANK
+
+# (regex over the RESOLVED dotted target, severity, label)
+DEFAULT_LEAVES: tuple[tuple[str, str, str], ...] = (
+    # pairing-class: multi-ms to seconds per call — never on the loop
+    (r"^drand_tpu\.crypto\.pairing\.", "high", "pairing"),
+    (r"^drand_tpu\.crypto\.batch\.(verify_beacons|verify_partials|"
+     r"verify_recovered_many|recover|aggregate_round|eval_commits)$",
+     "high", "engine dispatch"),
+    (r"^drand_tpu\.crypto\.batch_verify\.", "high", "RLC batch verify"),
+    (r"^drand_tpu\.crypto\.tbls\.(verify_partial|verify_recovered|"
+     r"recover|aggregate)", "high", "threshold BLS"),
+    (r"^drand_tpu\.chain\.beacon\.verify_beacon", "high", "beacon verify"),
+    (r"^drand_tpu\.ops\.engine\.", "high", "device engine"),
+    # bounded-but-real blocking: scalar muls, disk commits, sync waits
+    (r"^time\.sleep$", "medium", "time.sleep"),
+    (r"^sqlite3\.", "medium", "sqlite"),
+    (r"^socket\.", "medium", "sync socket"),
+    (r"^urllib\.request\.", "medium", "sync urllib"),
+    (r"^requests\.", "medium", "sync requests"),
+    (r"^subprocess\.(run|check_output|check_call|call)$", "medium",
+     "subprocess wait"),
+    (r"^drand_tpu\.crypto\.bls\.(sign|verify|keygen)$", "medium",
+     "BLS point op"),
+    (r"^drand_tpu\.crypto\.ecies\.(encrypt|decrypt)$", "medium",
+     "ECIES point op"),
+)
+
+# unresolved ``obj.method(...)`` fallback: bare attribute names that are
+# unambiguous in this codebase (curated — generic names like "recover"
+# or "put" would drown the pass in dynamic-dispatch guesses)
+DEFAULT_ATTR_LEAVES: dict[str, tuple[str, str]] = {
+    "verify_beacons": ("high", "engine dispatch"),
+    "aggregate_round": ("high", "engine dispatch"),
+    "verify_partials": ("high", "engine dispatch"),
+    "verify_recovered_many": ("high", "engine dispatch"),
+    "eval_commits": ("high", "engine dispatch"),
+    "miller_loop": ("high", "pairing"),
+    "pairing_check": ("high", "pairing"),
+    "pairing_check_groups": ("high", "pairing"),
+}
+
+# functions whose bodies are exempt (test scaffolding has no production
+# event loop; the analyzer package itself would self-flag its fixtures)
+DEFAULT_EXCLUDE_PREFIXES = ("drand_tpu.testing",)
+
+_MAX_PATH = 7
+
+
+def run(project: Project,
+        leaves: tuple[tuple[str, str, str], ...] = DEFAULT_LEAVES,
+        attr_leaves: dict[str, tuple[str, str]] | None = None,
+        exclude_prefixes: tuple[str, ...] = DEFAULT_EXCLUDE_PREFIXES,
+        ) -> list[Finding]:
+    if attr_leaves is None:
+        attr_leaves = DEFAULT_ATTR_LEAVES
+    leaf_res = [(re.compile(pat), sev, label) for pat, sev, label in leaves]
+
+    def excluded(qn: str) -> bool:
+        return any(qn.startswith(p) for p in exclude_prefixes)
+
+    # taint[qualname] = (severity, leaf description, path tuple)
+    taint: dict[str, tuple[str, str, tuple[str, ...]]] = {}
+
+    def offer(qn: str, sev: str, leaf: str, path: tuple[str, ...]) -> bool:
+        cur = taint.get(qn)
+        if cur is not None and (SEV_RANK[cur[0]], -len(cur[2])) >= \
+                (SEV_RANK[sev], -len(path)):
+            return False
+        taint[qn] = (sev, leaf, path)
+        return True
+
+    # seed: direct leaf calls
+    for fn in project.iter_functions():
+        if excluded(fn.qualname):
+            continue
+        for call in fn.calls:
+            sev_label = None
+            if call.target is not None:
+                for rx, sev, label in leaf_res:
+                    if rx.search(call.target):
+                        sev_label = (sev, f"{call.target} ({label})")
+                        break
+                # a project-internal call is not a leaf hit unless the
+                # regex matched; external targets only match via regex
+            if sev_label is None and call.target is None \
+                    and call.attr in attr_leaves:
+                sev, label = attr_leaves[call.attr]
+                sev_label = (sev, f".{call.attr}(...) ({label})")
+            if sev_label is not None:
+                offer(fn.qualname, sev_label[0], sev_label[1],
+                      (fn.qualname, sev_label[1]))
+
+    # reverse edges: caller -> set of project callees
+    callers: dict[str, set[str]] = {}
+    for fn in project.iter_functions():
+        if excluded(fn.qualname):
+            continue
+        for call in fn.calls:
+            if call.target in project.functions \
+                    and not excluded(call.target):
+                callers.setdefault(call.target, set()).add(fn.qualname)
+
+    # propagate up to a fixpoint
+    work = list(taint.keys())
+    while work:
+        callee = work.pop()
+        sev, leaf, path = taint[callee]
+        if len(path) >= _MAX_PATH:
+            continue
+        for caller in callers.get(callee, ()):
+            if offer(caller, sev, leaf, (caller,) + path):
+                work.append(caller)
+
+    findings: list[Finding] = []
+    for fn in project.iter_functions():
+        if not fn.is_async or fn.qualname not in taint:
+            continue
+        sev, leaf, path = taint[fn.qualname]
+        chain = " -> ".join(p.split(".")[-1] if i else p
+                            for i, p in enumerate(path))
+        kind = "pairing-class" if sev == "high" else "blocking"
+        findings.append(Finding(
+            pass_name="loopblock",
+            rule=f"async-blocking-{sev}",
+            severity=sev,
+            path=fn.module.relpath,
+            line=fn.line,
+            symbol=fn.qualname,
+            message=(f"async `{fn.qualname}` reaches {kind} call "
+                     f"{leaf} with no executor hand-off: {chain} — wrap "
+                     f"the blocking step in asyncio.to_thread(...)"),
+            # the leaf scopes baseline entries: suppressing the reviewed
+            # eval_commits path must not also suppress a verify_beacons
+            # call someone adds to the same function later
+            detail=leaf,
+        ))
+    findings.sort(key=lambda f: (-SEV_RANK[f.severity], f.path, f.line))
+    return findings

@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Aggregating runner for the drand-tpu static-analysis suite.
+
+    python tools/analyze/run.py [--json] [--fail-on high|medium|low]
+                                [--passes loopblock,secretflow,...]
+                                [--baseline PATH] [--root DIR]
+
+    drand-tpu analyze [--json] [--fail-on ...]     (same thing via CLI)
+
+Host-only and import-free with respect to the analyzed code: everything
+is AST, so no jax backend ever initializes and a full-tree run takes
+about a second. Exit status 1 iff any finding at/above ``--fail-on``
+(default: high) is not suppressed by the baseline.
+
+Baseline (tools/analyze/baseline.json): reviewed suppressions.
+
+    {"entries": [{"key": "<finding key>", "reason": "<why it is ok>"}]}
+
+Every entry MUST carry a non-empty reason — an unexplained suppression
+is itself a high finding. Entries matching nothing (the code got fixed)
+are flagged medium so the file never accretes dead weight. Finding keys
+are printed with each finding and are line-number-free, so baselines
+survive unrelated edits — but loopblock keys DO include the leaf the
+path reaches, so suppressing one reviewed blocking call does not also
+suppress a different blocking call added to the same function later.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+if __package__ in (None, ""):  # executed as a script
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
+    from tools.analyze import asyncsanity, jaxhazard, loopblock, secretflow
+    from tools.analyze.core import Finding, Project, SEV_RANK
+else:
+    from . import asyncsanity, jaxhazard, loopblock, secretflow
+    from .core import Finding, Project, SEV_RANK
+
+REPO = pathlib.Path(__file__).resolve().parents[2]
+DEFAULT_BASELINE = pathlib.Path(__file__).resolve().parent / "baseline.json"
+PASSES = ("loopblock", "secretflow", "jaxhazard", "asyncsanity", "metrics")
+
+
+def _metrics_pass(root: pathlib.Path) -> list[Finding]:
+    """tools/check_metrics.py folded in as the fifth pass, so tier-1 and
+    operators drive ONE entry point. Still runnable standalone."""
+    if root.resolve() != REPO:
+        return []  # catalogue lint is repo-specific, skip on fixtures
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import check_metrics
+        problems = check_metrics.run_lint()
+    finally:
+        sys.path.remove(str(REPO / "tools"))
+    out = []
+    for p in problems:
+        import hashlib
+        tag = hashlib.blake2b(p.encode(), digest_size=4).hexdigest()
+        out.append(Finding(
+            pass_name="metrics", rule="catalogue", severity="high",
+            path="drand_tpu/metrics/__init__.py", line=1,
+            symbol=f"problem-{tag}", message=p))
+    return out
+
+
+def load_baseline(path: pathlib.Path) -> tuple[dict[str, str], list[Finding]]:
+    """key -> reason, plus findings for malformed entries."""
+    problems: list[Finding] = []
+    if not path.is_file():
+        return {}, problems
+    rel = str(path)
+    try:
+        doc = json.loads(path.read_text())
+    except ValueError as e:
+        problems.append(Finding(
+            pass_name="baseline", rule="malformed", severity="high",
+            path=rel, line=1, symbol="<baseline>",
+            message=f"baseline is not valid JSON: {e}"))
+        return {}, problems
+    out: dict[str, str] = {}
+    for i, entry in enumerate(doc.get("entries", [])):
+        key = entry.get("key", "")
+        reason = (entry.get("reason") or "").strip()
+        if not key:
+            problems.append(Finding(
+                pass_name="baseline", rule="malformed", severity="high",
+                path=rel, line=1, symbol=f"entry-{i}",
+                message="baseline entry missing 'key'"))
+            continue
+        if len(reason) < 10:
+            problems.append(Finding(
+                pass_name="baseline", rule="missing-reason",
+                severity="high", path=rel, line=1, symbol=key,
+                message=(f"baseline entry {key!r} has no written reason "
+                         f"— every suppression must explain why the "
+                         f"finding is acceptable")))
+            continue
+        out[key] = reason
+    return out, problems
+
+
+def run_analysis(root: str | pathlib.Path = REPO,
+                 passes: tuple[str, ...] = PASSES,
+                 baseline_path: str | pathlib.Path | None = None,
+                 packages: tuple[str, ...] | None = None) -> dict:
+    """-> {"findings": [...], "suppressed": [...], "counts": {...}}.
+
+    ``findings`` are unsuppressed, strongest first. ``root`` defaults to
+    the repo; fixture tests point it at temp trees (which skips the
+    repo-specific metrics pass automatically).
+    """
+    root = pathlib.Path(root)
+    if packages is None and root.resolve() == REPO:
+        packages = ("drand_tpu",)
+    project = Project(root, packages=packages)
+    all_findings: list[Finding] = []
+    if "loopblock" in passes:
+        all_findings.extend(loopblock.run(project))
+    if "secretflow" in passes:
+        all_findings.extend(secretflow.run(project))
+    if "jaxhazard" in passes:
+        all_findings.extend(jaxhazard.run(project))
+    if "asyncsanity" in passes:
+        all_findings.extend(asyncsanity.run(project))
+    if "metrics" in passes:
+        all_findings.extend(_metrics_pass(root))
+
+    bl_path = pathlib.Path(baseline_path) if baseline_path \
+        else DEFAULT_BASELINE
+    baseline, bl_problems = load_baseline(bl_path)
+    all_findings.extend(bl_problems)
+
+    suppressed, open_findings = [], []
+    used_keys: set[str] = set()
+    for f in all_findings:
+        if f.key in baseline:
+            used_keys.add(f.key)
+            suppressed.append(f)
+        else:
+            open_findings.append(f)
+    for key in sorted(set(baseline) - used_keys):
+        # staleness is only decidable for entries whose pass actually
+        # ran this invocation — a --passes subset must not misreport
+        # the other passes' suppressions as dead
+        if key.split(":", 1)[0] not in passes:
+            continue
+        open_findings.append(Finding(
+            pass_name="baseline", rule="stale-entry", severity="medium",
+            path=str(bl_path), line=1, symbol=key,
+            message=(f"baseline entry {key!r} matches no current finding "
+                     f"— the code was fixed; delete the entry")))
+
+    open_findings.sort(
+        key=lambda f: (-SEV_RANK[f.severity], f.pass_name, f.path, f.line))
+    counts: dict[str, int] = {}
+    for f in open_findings:
+        counts[f.severity] = counts.get(f.severity, 0) + 1
+    return {
+        "findings": open_findings,
+        "suppressed": suppressed,
+        "counts": counts,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="drand analyze",
+        description="drand-tpu AST static-analysis suite")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--fail-on", choices=("high", "medium", "low"),
+                    default="high",
+                    help="exit 1 when an unsuppressed finding at/above "
+                         "this severity exists (default: high)")
+    ap.add_argument("--passes", default=",".join(PASSES),
+                    help=f"comma-separated subset of {','.join(PASSES)}")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: {DEFAULT_BASELINE})")
+    ap.add_argument("--root", default=None,
+                    help="tree to analyze (default: this repo)")
+    args = ap.parse_args(argv)
+
+    passes = tuple(p.strip() for p in args.passes.split(",") if p.strip())
+    unknown = set(passes) - set(PASSES)
+    if unknown:
+        ap.error(f"unknown pass(es): {sorted(unknown)}")
+    report = run_analysis(root=args.root or REPO, passes=passes,
+                          baseline_path=args.baseline)
+
+    findings = report["findings"]
+    threshold = SEV_RANK[args.fail_on]
+    failing = [f for f in findings if SEV_RANK[f.severity] >= threshold]
+
+    if args.json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "suppressed": [f.to_dict() for f in report["suppressed"]],
+            "counts": report["counts"],
+            "fail_on": args.fail_on,
+            "failing": len(failing),
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        n_sup = len(report["suppressed"])
+        counts = " ".join(f"{k}={v}"
+                          for k, v in sorted(report["counts"].items()))
+        print(f"\nanalyze: {len(findings)} finding(s) "
+              f"({counts or 'none'}), {n_sup} suppressed by baseline, "
+              f"{len(failing)} at/above --fail-on={args.fail_on}")
+        if failing:
+            print("analyze: FAIL — fix the finding or add a baseline "
+                  "entry with a written reason (key printed above)")
+        else:
+            print("analyze: OK")
+    return 1 if failing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

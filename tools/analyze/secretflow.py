@@ -1,0 +1,176 @@
+"""secretflow: secret material flowing into observable surfaces.
+
+Sources are identifiers that name key material (private shares, DKG
+secrets, ECIES/HKDF-derived keys, setup secrets) plus anything assigned
+from such an identifier within the same function. Sinks are the places
+an operator — or anyone scraping /metrics, /debug/trace or the logs —
+can read: logger calls, ``print``, metric ``.labels(...)`` values,
+exception constructor arguments, and trace-span attributes.
+
+A name bound to an imported MODULE never taints (the ``secrets`` stdlib
+module is the obvious trap), and string constants never taint — only
+references to secret-named values do.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, FuncInfo, Project
+
+SECRET_NAME_RE = re.compile(
+    r"(?i)(^|_)(sk|secret|secrets|pri_share|private_key|privkey|"
+    r"enc_key|mac_key|ikm|okm|prk|keystream|share_secret|dist_key|"
+    r"longterm_key)(_|$)")
+
+_LOG_METHODS = {"debug", "info", "warn", "warning", "error", "exception",
+                "critical"}
+
+# calls that PRESERVE their argument's content (a secret stays a secret
+# through these); any other call's return value is treated as laundered
+# — `out = rpc_call(secret)` yields a status object, not the secret,
+# and flagging it would bury the real leaks in noise
+_CONVERTERS = {"str", "bytes", "hex", "repr", "format", "int", "dumps",
+               "hexlify", "b64encode", "b16encode", "to_bytes", "to_json",
+               "join", "encode", "decode"}
+
+
+def _is_module_alias(name: str, fn: FuncInfo) -> bool:
+    target = fn.module.imports.get(name)
+    # an import bound to a dotted module path (or bare module) is a
+    # module alias; "from x import y" also lands here but a secret
+    # VALUE imported across modules keeps its secret name and still
+    # matches at its definition site's sinks
+    return target is not None
+
+
+def _tainted_names(expr: ast.AST, local_taint: set[str],
+                   fn: FuncInfo) -> list[str]:
+    """Secret-named references inside ``expr``, with call-result
+    laundering: names feeding a non-converter call's arguments do not
+    taint the surrounding expression (constants never taint)."""
+    out: list[str] = []
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, ast.Call):
+            visit(node.func)  # a method ON a secret still taints
+            fname = None
+            if isinstance(node.func, ast.Name):
+                fname = node.func.id
+            elif isinstance(node.func, ast.Attribute):
+                fname = node.func.attr
+            if fname in _CONVERTERS:
+                for a in node.args:
+                    visit(a)
+                for kw in node.keywords:
+                    if kw.value is not None:
+                        visit(kw.value)
+            return
+        if isinstance(node, ast.Name):
+            if node.id in local_taint or (
+                    SECRET_NAME_RE.search(node.id)
+                    and not _is_module_alias(node.id, fn)):
+                out.append(node.id)
+        elif isinstance(node, ast.Attribute):
+            if SECRET_NAME_RE.search(node.attr):
+                out.append(node.attr)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(expr)
+    return out
+
+
+def run(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for fn in project.iter_functions():
+        findings.extend(_scan_function(fn))
+    findings.sort(key=lambda f: (f.path, f.line))
+    return findings
+
+
+def _scan_function(fn: FuncInfo) -> list[Finding]:
+    # one-hop local propagation: x = <expr referencing a secret name>
+    local_taint: set[str] = set()
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            if _tainted_names(node.value, set(), fn):
+                local_taint.add(node.targets[0].id)
+
+    out: list[Finding] = []
+
+    def emit(rule: str, line: int, names: list[str], sink: str) -> None:
+        uniq = sorted(set(names))
+        out.append(Finding(
+            pass_name="secretflow", rule=rule, severity="high",
+            path=fn.module.relpath, line=line, symbol=fn.qualname,
+            message=(f"secret-named value(s) {', '.join(uniq)} flow into "
+                     f"{sink} in `{fn.qualname}` — key material must "
+                     f"never reach logs/metrics/traces/exceptions"),
+        ))
+
+    def check_call_args(call: ast.Call) -> list[str]:
+        names: list[str] = []
+        for a in call.args:
+            names.extend(_tainted_names(a, local_taint, fn))
+        for kw in call.keywords:
+            if kw.value is not None:
+                names.extend(_tainted_names(kw.value, local_taint, fn))
+        return names
+
+    skip = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+
+    def walk(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, skip):
+                continue
+            if isinstance(child, ast.Raise) and isinstance(child.exc,
+                                                           ast.Call):
+                names = check_call_args(child.exc)
+                if names:
+                    emit("secret-in-exception", child.lineno, names,
+                         "an exception message")
+            elif isinstance(child, ast.Call):
+                func = child.func
+                if isinstance(func, ast.Attribute):
+                    if func.attr in _LOG_METHODS:
+                        names = check_call_args(child)
+                        if names:
+                            emit("secret-in-log", child.lineno, names,
+                                 "a log line")
+                    elif func.attr == "labels":
+                        names = check_call_args(child)
+                        if names:
+                            emit("secret-in-metric-label", child.lineno,
+                                 names, "a metric label")
+                    elif func.attr == "span":
+                        names = check_call_args(child)
+                        if names:
+                            emit("secret-in-trace-attr", child.lineno,
+                                 names, "a trace-span attribute")
+                    elif func.attr == "update" and isinstance(
+                            func.value, ast.Attribute) \
+                            and func.value.attr == "attrs":
+                        names = check_call_args(child)
+                        if names:
+                            emit("secret-in-trace-attr", child.lineno,
+                                 names, "a trace-span attribute")
+                elif isinstance(func, ast.Name) and func.id == "print":
+                    names = check_call_args(child)
+                    if names:
+                        emit("secret-in-print", child.lineno, names,
+                             "stdout")
+            walk(child)
+
+    for stmt in fn.node.body:
+        if isinstance(stmt, skip):
+            continue
+        walk(stmt)
+        if isinstance(stmt, ast.Raise) and isinstance(stmt.exc, ast.Call):
+            names = check_call_args(stmt.exc)
+            if names:
+                emit("secret-in-exception", stmt.lineno, names,
+                     "an exception message")
+    return out

@@ -20,6 +20,16 @@
 # exits 0 without running anything, so a CI log's chunked verdicts are
 # auditable against exactly which files each chunk covered.
 #
+# Registration is by glob: every tests/test_*.py is picked up
+# automatically. New suites MUST keep the conventions the chunking
+# relies on: compile-heavy device suites and new subsystem suites go
+# late-alphabet (test_zz_*) so the capped single tier-1 invocation
+# keeps its early-dot throughput. Currently registered late-alphabet:
+#   test_zz_analyze.py     static-analysis suite (host-only, <60 s,
+#                          no backend init — pure AST + one aiohttp
+#                          harness)
+#   test_zz_obs_health.py  chain-health SLO / OTLP export suite
+#
 # Exit status: 0 iff every chunk passed.
 
 set -u
