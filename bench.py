@@ -28,10 +28,13 @@ Environment knobs:
     BENCH_MIN_SECONDS  minimum timed window per trial (default 5.0)
     BENCH_TRIALS       trials per config (default 2; best wins)
     BENCH_CONFIGS      comma list to run: any of
-                       msm,rlc,obs,e2e,catchup,recover,deal,replay,
-                       headline (default: all; msm, rlc and obs are
-                       host-only and run FIRST, before backend init, so
-                       they report even with the TPU tunnel down)
+                       msm,glv4,rlc,obs,shard,e2e,catchup,recover,
+                       deal,replay,headline (default: all; msm, glv4,
+                       rlc, obs and shard are host-only and run FIRST,
+                       before backend init, so they report even with
+                       the TPU tunnel down — shard re-execs onto the
+                       virtual CPU mesh and is bounded by the remaining
+                       budget)
     DRAND_TPU_CONV     tree|kara|unroll — limb conv strategy (A/B)
     DRAND_TPU_LAZY     1|0 — lazy Fp2/6/12 reduction (A/B)
     DRAND_TPU_PAIRFOLD 1|0 — paired-line Miller fold (A/B)
@@ -523,6 +526,86 @@ def bench_msm_pippenger(trials):
             "vs_baseline": None}
 
 
+def bench_msm_glv4(trials):
+    """Host MSM full-width strategy A/B on a 64-point G2 span with
+    255-bit scalars: the ψ² 4-D GLS Pippenger (crypto/batch_verify.msm
+    — what recover's Lagrange combine and any wide-scalar RLC span now
+    run) vs the interleaved 4-bit-window ladder at 255 bits
+    (msm_window, the reference). Pure host crypto, runs before backend
+    init — the GLV-4 win is reportable with the tunnel down, per the
+    msm_pippenger_speedup pattern."""
+    import secrets
+
+    from drand_tpu.crypto import batch_verify, endo
+    from drand_tpu.crypto.curves import PointG2
+
+    span, nbits = 64, 255
+    g2 = PointG2.generator()
+    points = [g2.mul(3 + 2 * i) for i in range(span)]
+    scalars = [secrets.randbits(nbits) | 1 for _ in range(span)]
+    expect = batch_verify.msm_window(points, scalars, nbits=nbits)
+    if batch_verify.msm(points, scalars) != expect:
+        raise RuntimeError("GLV-4 MSM disagrees with the window MSM")
+
+    def timed(fn):
+        def run():
+            t0 = time.perf_counter()
+            fn()
+            return time.perf_counter() - t0
+        return run
+
+    trials = min(trials, 3)
+    dt_glv4 = best_of(trials, timed(
+        lambda: batch_verify.msm(points, scalars)))
+    dt_win = best_of(trials, timed(
+        lambda: batch_verify.msm_window(points, scalars, nbits=nbits)))
+    return {"metric": "msm_glv4_speedup",
+            "value": round(dt_win / dt_glv4, 2), "unit": "x",
+            "span": span, "scalar_bits": nbits,
+            "digit_bits": endo.GLS4_DIGIT_BITS,
+            "window_seconds": round(dt_win, 3),
+            "glv4_seconds": round(dt_glv4, 3),
+            "vs_baseline": None}
+
+
+def bench_sharded_catchup(budget_left):
+    """Mesh-sharded wire-RLC catch-up on the virtual CPU mesh, driven
+    through the driver's dryrun_multichip (per-shard device h2c +
+    lane-MSM, ONE cross-shard reduction, 2 Miller pairs per span —
+    meter-proven in the child). Runs in a JAX_PLATFORMS=cpu subprocess,
+    so it reports without touching the (possibly down) TPU tunnel; the
+    CPU-mesh rate proves the composition, not throughput."""
+    import subprocess
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import __graft_entry__ as graft
+
+    # child wall is compile-dominated (~5-10 min cold); cap it by the
+    # remaining bench budget so this aux config can never starve the
+    # headline, and skip the unrelated verify+recover dryrun leg
+    timeout = max(120.0, min(float(os.environ.get(
+        "DRAND_TPU_MULTICHIP_TIMEOUT", "1800")), budget_left))
+    saved = {k: os.environ.get(k) for k in
+             ("DRAND_TPU_MULTICHIP_TIMEOUT", "DRAND_TPU_DRYRUN_ONLY_CATCHUP")}
+    os.environ["DRAND_TPU_MULTICHIP_TIMEOUT"] = str(timeout)
+    os.environ["DRAND_TPU_DRYRUN_ONLY_CATCHUP"] = "1"
+    try:
+        out = graft._reexec_on_cpu_mesh(8, capture=True)
+    except (RuntimeError, subprocess.SubprocessError) as e:
+        raise RuntimeError(f"sharded catch-up dryrun failed: {e}") from e
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    for line in reversed(out.splitlines()):
+        if line.startswith("SHARDED_CATCHUP "):
+            record = json.loads(line[len("SHARDED_CATCHUP "):])
+            return dict(record, vs_baseline=None)
+    raise RuntimeError("dryrun produced no SHARDED_CATCHUP record")
+
+
 def bench_replay_measured(budget_left, catchup_result=None):
     """1M-round replay, MEASURED (BASELINE config 5; the reference's
     de-facto capability of replaying a real chain —
@@ -664,7 +747,8 @@ def main() -> None:
     t_start = time.perf_counter()
     which = os.environ.get(
         "BENCH_CONFIGS",
-        "msm,rlc,obs,e2e,catchup,recover,deal,replay,headline").split(",")
+        "msm,glv4,rlc,obs,shard,e2e,catchup,recover,deal,replay,"
+        "headline").split(",")
 
     # --- outage-proofing (round-3 lesson: the official record must never
     # be an unparseable traceback). Two layers:
@@ -734,6 +818,16 @@ def main() -> None:
             log(traceback.format_exc())
             diag("aux_config_failed", config="msm",
                  error=f"{type(e).__name__}: {e}")
+    if "glv4" in which:
+        log("== host MSM GLS psi^2 4-D speedup (255-bit G2 scalars) ==")
+        try:
+            emit(bench_msm_glv4(trials))
+        except Exception as e:  # noqa: BLE001 — best-effort aux config
+            import traceback
+
+            log(traceback.format_exc())
+            diag("aux_config_failed", config="glv4",
+                 error=f"{type(e).__name__}: {e}")
     if "rlc" in which:
         log("== host RLC batch-verify speedup (64-beacon span) ==")
         try:
@@ -754,6 +848,31 @@ def main() -> None:
             log(traceback.format_exc())
             diag("aux_config_failed", config="obs",
                  error=f"{type(e).__name__}: {e}")
+
+    if "shard" in which:
+        # host-only like msm/rlc/obs (the work runs in a CPU-pinned
+        # subprocess), but compile-heavy — bound by the remaining budget
+        # so the cheap aux records and the headline are never starved
+        left = budget - (time.perf_counter() - t_start)
+        if left < 120.0:
+            # bench_sharded_catchup floors its child watchdog at 120 s;
+            # with less budget than that left, running it would overrun
+            # the budget the floor exists to respect — skip instead
+            log(f"== skipping shard: budget exhausted "
+                f"(left={left:.0f}s < 120s) ==")
+            diag("aux_config_skipped", config="shard",
+                 error="budget exhausted")
+        else:
+            log(f"== sharded wire-RLC catch-up on the virtual CPU mesh "
+                f"(budget_left={left:.0f}s) ==")
+            try:
+                emit(bench_sharded_catchup(left))
+            except Exception as e:  # noqa: BLE001 — best-effort aux config
+                import traceback
+
+                log(traceback.format_exc())
+                diag("aux_config_failed", config="shard",
+                     error=f"{type(e).__name__}: {e}")
 
     from drand_tpu.utils.backend import BackendUnavailable, init_backend
 

@@ -25,6 +25,17 @@ from ..store import CallbackStore, StoreError
 SYNC_CHUNK = int(os.environ.get("DRAND_TPU_SYNC_CHUNK", "64"))
 
 
+def _verify_chunk_size() -> int:
+    """SYNC_CHUNK rounded UP to a multiple of the engine's mesh size, so
+    a mesh-sharded engine's catch-up chunks divide evenly across shards
+    and the sharded wire-RLC tier engages with zero pad waste (odd
+    chunks still verify correctly — the engine pads to the mesh — but a
+    divisible chunk is all live lanes). A cheap attribute peek
+    (crypto/batch.engine_mesh_size), loop-safe by construction."""
+    mesh = batch.engine_mesh_size()
+    return -(-SYNC_CHUNK // mesh) * mesh
+
+
 async def _chunks(stream: AsyncIterator[Beacon], size: int):
     """Re-chunk an async stream into lists of up to `size`, flushing early
     when the producer stalls (so live streams stay per-item latency).
@@ -130,7 +141,7 @@ class Syncer:
             return False
         try:
             stream = self._client.sync_chain(peer, SyncRequest(from_round=last.round + 1))
-            async for chunk in _chunks(stream, SYNC_CHUNK):
+            async for chunk in _chunks(stream, _verify_chunk_size()):
                 # batched dual verification: V1 chain link and — hardening
                 # over the reference, which skips this (sync.go:105) — the V2
                 # signature when present, so a malicious sync peer cannot

@@ -154,7 +154,7 @@ def _note_dispatch(op: str) -> None:
 # (seconds to minutes cold) and is split into engine_compile_seconds so
 # steady-state engine_op_seconds percentiles stay alertable. Host paths
 # never compile; only device-side paths divert.
-_COMPILE_PATHS = ("device", "wire_rlc")
+_COMPILE_PATHS = ("device", "wire_rlc", "wire_rlc_sharded")
 _WARM_SHAPES: set[tuple[str, str, str]] = set()
 
 
@@ -277,6 +277,17 @@ def engine():
     return _ENGINE
 
 
+def engine_mesh_size() -> int:
+    """Mesh size of the ALREADY-CREATED engine (1 otherwise) — a cheap
+    attribute peek for callers sizing work mesh-divisibly (the syncer's
+    verify chunks). Never constructs the engine: backend init can hang
+    with the tunnel down, and chunk sizing must stay loop-safe."""
+    eng = _ENGINE
+    if eng is None or getattr(eng, "mesh", None) is None:
+        return 1
+    return int(eng.mesh.devices.size)
+
+
 def _use_device(n_items: int) -> bool:
     if _MODE == "host":
         return False
@@ -303,15 +314,28 @@ def verify_beacons(pubkey: PointG1, beacons,
             n_checks = sum(1 + (1 if b.is_v2() else 0) for b in beacons)
             if eng.wire_rlc_active(n_checks):
                 # wire-RLC tier: device h2c + in-graph lane-MSM collapse
-                # the span to ONE 2-pairing row (ops/engine.py). A None
-                # return is the false-reject-only fallback — re-dispatch
-                # below through the per-item wire graph for exact
-                # verdicts, under its own path label.
-                with _timed("verify_beacons", "wire_rlc", len(beacons)):
-                    out = eng.verify_beacons_wire_rlc(pubkey, beacons, dst)
+                # the span to ONE 2-pairing row (ops/engine.py); on a
+                # mesh engine the combine shards over the batch axis and
+                # reports under its own label. A None return is the
+                # false-reject-only fallback — re-dispatch below through
+                # the per-item wire graph for exact verdicts, under its
+                # own path label.
+                # literal path labels in each branch — check_metrics
+                # lints _timed labels against the documented enum
+                if eng.wire_rlc_sharded_active(n_checks):
+                    with _timed("verify_beacons", "wire_rlc_sharded",
+                                len(beacons)):
+                        out = eng.verify_beacons_wire_rlc(pubkey, beacons,
+                                                          dst)
+                    tier = "wire_rlc_sharded"
+                else:
+                    with _timed("verify_beacons", "wire_rlc", len(beacons)):
+                        out = eng.verify_beacons_wire_rlc(pubkey, beacons,
+                                                          dst)
+                    tier = "wire_rlc"
                 if out is None:
                     _ledger_note(
-                        "verify_beacons", "wire_rlc",
+                        "verify_beacons", tier,
                         "combine rejected (failed combined check / "
                         "untrusted shape) — per-item wire graph decides")
             if out is None:

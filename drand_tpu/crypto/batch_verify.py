@@ -128,10 +128,16 @@ def decode_sig(sig_bytes: bytes) -> PointG2 | None:
 #   twice the (cheap, bucketed) points. The whole span is normalized
 #   with ONE simultaneous inversion (batch_to_affine) so ψ costs two
 #   Fp2 multiplications per point.
+# - the ψ² 4-D GLS split (``_endo_split4_g2``) extends the same idea to
+#   FULL-WIDTH scalars: any c (reduced mod r < M⁴) becomes four base-M
+#   digits on (P, -ψP, ψ²P, -ψ³P) (endo.gls4_decompose/basis), so
+#   255-bit Lagrange/verification scalars run a quarter-length chain —
+#   the split ``recover``'s device ladders use too (ops/engine.py).
 #
-# ``msm`` dispatches: G2 spans split through ψ, then bucket-vs-window by
-# effective size. This is the term that must stay well under a Miller
-# loop for the span speedup.
+# ``msm`` dispatches: G2 spans split through ψ (two lanes for RLC-width
+# scalars, four GLS lanes beyond), then bucket-vs-window by effective
+# size. This is the term that must stay well under a Miller loop for
+# the span speedup.
 # ---------------------------------------------------------------------------
 
 _MSM_WINDOW = 4
@@ -238,12 +244,36 @@ def _endo_split_g2(points: list[PointG2], scalars: list[int]):
     return pts2, sc2
 
 
+def _endo_split4_g2(points: list[PointG2], scalars: list[int]):
+    """(points, any-width scalars) -> (<= 4x points, <= GLS4_DIGIT_BITS
+    scalars) via the ψ² 4-D GLS decomposition: c mod r in base M = -x
+    gives four <= 64-bit digits on (P, -ψP, ψ²P, -ψ³P)
+    (endo.gls4_decompose / gls4_points_from_affine — every caller feeds
+    subgroup-checked points, where ψ = [x] holds)."""
+    xys = PointG2.batch_to_affine(points)
+    pts4: list[PointG2] = []
+    sc4: list[int] = []
+    for (x, y), s in zip(xys, scalars):
+        digits = endo.gls4_decompose(s)
+        basis = None
+        for k, d in enumerate(digits):
+            if not d:
+                continue
+            if basis is None:
+                basis = endo.gls4_points_from_affine(x, y)
+            pts4.append(basis[k])
+            sc4.append(d)
+    return pts4, sc4
+
+
 def msm(points: list[_JacobianPoint], scalars: list[int]):
-    """sum_i scalars_i * points_i for nonnegative scalars < 2^128 — the
-    RLC combine dispatcher: G2 spans ψ-split to ~64-bit scalars, then
-    bucket method above _PIPPENGER_MIN effective points, windowed ladder
-    below. Bit-exact with msm_window on every input (pure regrouping of
-    the same group operation)."""
+    """sum_i scalars_i * points_i for nonnegative scalars — the RLC/
+    Lagrange combine dispatcher: G2 spans ψ-split (two lanes for
+    <= 128-bit scalars, four ψ² GLS lanes for full-width ones) to
+    ~64-bit scalars, then bucket method above _PIPPENGER_MIN effective
+    points, windowed ladder below. Value-identical to msm_window on
+    every input (pure regrouping of the same group operation; wide
+    scalars reduce mod the group order first)."""
     if not points:
         raise ValueError("empty MSM")
     cls = type(points[0])
@@ -253,12 +283,19 @@ def msm(points: list[_JacobianPoint], scalars: list[int]):
         return cls.infinity()
     pts = [p for p, _ in live]
     scs = [s for _, s in live]
-    nbits = RLC_SCALAR_BITS
     if isinstance(pts[0], PointG2):
-        pts, scs = _endo_split_g2(pts, scs)
-        nbits = _ENDO_Q_BITS
+        if any(s >> RLC_SCALAR_BITS for s in scs):
+            pts, scs = _endo_split4_g2(pts, scs)
+            nbits = endo.GLS4_DIGIT_BITS
+        else:
+            pts, scs = _endo_split_g2(pts, scs)
+            nbits = _ENDO_Q_BITS
         if not pts:
             return cls.infinity()
+    else:
+        # G1 spans have no ψ: size the chain to the widest scalar
+        nbits = max(RLC_SCALAR_BITS,
+                    max(s.bit_length() for s in scs))
     if len(pts) >= _PIPPENGER_MIN:
         return msm_pippenger(pts, scs, nbits)
     return msm_window(pts, scs, nbits)

@@ -55,6 +55,47 @@ PSI_CX, PSI_CY = _solve_constants()
 # per-coordinate Fp2 multipliers)
 PSI2_CX = PSI_CX * PSI_CX.conjugate()
 PSI2_CY = PSI_CY * PSI_CY.conjugate()
+# ψ³ = ψ∘ψ²: ψ²(x,y) = (PSI2_CX·x, PSI2_CY·y), then one more conjugation
+# pass pulls the ψ² multipliers through as their conjugates
+PSI3_CX = PSI_CX * PSI2_CX.conjugate()
+PSI3_CY = PSI_CY * PSI2_CY.conjugate()
+
+# --- GLS 4-D scalar decomposition via ψ² ----------------------------------
+# ψ acts as [x] on the r-order subgroup (x = X_BLS < 0), so with
+# M = -x (> 0, 64 bits) the powers [M^k]P are ±ψ^k(P):
+#     [M]P = -ψ(P),  [M²]P = ψ²(P),  [M³]P = -ψ³(P).
+# r = x⁴ - x² + 1 = M⁴ - M² + 1 < M⁴, so every scalar c (reduced mod r)
+# has exactly four base-M digits, each <= M-1 < 2^64 — a 255-bit ladder
+# becomes four <= GLS4_DIGIT_BITS-bit ladders on (P, -ψP, ψ²P, -ψ³P).
+GLS4_M = -X_BLS
+GLS4_DIGIT_BITS = GLS4_M.bit_length()  # 64
+if R >= GLS4_M ** 4:
+    raise AssertionError("GLS4: r >= M^4 — four base-M digits insufficient")
+
+
+def gls4_decompose(c: int) -> tuple[int, int, int, int]:
+    """Base-M digits (d0, d1, d2, d3) of ``c mod r``, each < 2^64, with
+    c·P = d0·P + d1·[M]P + d2·[M²]P + d3·[M³]P on the r-order subgroup."""
+    c %= R
+    d0 = c % GLS4_M
+    c //= GLS4_M
+    d1 = c % GLS4_M
+    c //= GLS4_M
+    d2 = c % GLS4_M
+    return d0, d1, d2, c // GLS4_M
+
+
+def gls4_points_from_affine(x: Fp2, y: Fp2) -> list[PointG2]:
+    """The GLS basis [P, [M]P, [M²]P, [M³]P] = [P, -ψP, ψ²P, -ψ³P] from
+    known-affine coordinates — six Fp2 multiplications, no inversions
+    (callers normalize whole spans with one batch_to_affine). P must be
+    in the r-order subgroup (ψ = [x] only holds there)."""
+    xb, yb = x.conjugate(), y.conjugate()
+    one = Fp2.one()
+    return [PointG2(x, y, one),
+            PointG2(PSI_CX * xb, -(PSI_CY * yb), one),
+            PointG2(PSI2_CX * x, PSI2_CY * y, one),
+            PointG2(PSI3_CX * xb, -(PSI3_CY * yb), one)]
 
 
 def psi(q: PointG2) -> PointG2:
@@ -78,6 +119,14 @@ def psi2(q: PointG2) -> PointG2:
         return q
     x, y = q.to_affine()
     return PointG2(PSI2_CX * x, PSI2_CY * y, Fp2.one())
+
+
+def psi3(q: PointG2) -> PointG2:
+    if q.is_infinity():
+        return q
+    x, y = q.to_affine()
+    return PointG2(PSI3_CX * x.conjugate(), PSI3_CY * y.conjugate(),
+                   Fp2.one())
 
 
 def subgroup_check_fast(q: PointG2) -> bool:
@@ -117,6 +166,16 @@ def _validate() -> None:
         raise ValueError("psi2 != psi∘psi")
     if not subgroup_check_fast(g):
         raise ValueError("fast subgroup check rejected a subgroup point")
+    if psi3(g) != psi(psi2(g)):
+        raise ValueError("psi3 != psi∘psi2")
+    # the 4-D GLS identity on one wide scalar: Σ d_k·[M^k]P == c·P
+    c = 0x6AF3_19C2_0000_0001_DEAD_BEEF_0000_7777_0123_4567_89AB_CDEF_FFFF_FFFF_0000_0003 % R
+    d0, d1, d2, d3 = gls4_decompose(c)
+    basis = gls4_points_from_affine(*g.to_affine())
+    acc = basis[0].mul(d0) + basis[1].mul(d1) \
+        + basis[2].mul(d2) + basis[3].mul(d3)
+    if acc != _mul_int(g, c):
+        raise ValueError("GLS4 decomposition check failed")
     # BP cofactor clearing must equal the generic [h_eff] multiplication
     # on a NON-subgroup curve point (a hash_to_curve pre-clearing output)
     from .hash_to_curve import hash_to_g2  # noqa: F401 (import check)

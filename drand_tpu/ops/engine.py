@@ -71,7 +71,11 @@ WIRE_MAX_BUCKET = 128
 # in-graph lane MSM, so catch-up costs 2 Miller loops end-to-end with no
 # host hashing either — dispatched by crypto/batch.py under
 # engine_op_seconds{path="wire_rlc"} with false-reject-only fallback to
-# the per-item wire graph.
+# the per-item wire graph. On a mesh engine the combine additionally
+# SHARDS over the batch axis (per-shard h2c + decompression + lane-MSM,
+# one cross-shard reduction before the single pairing row) under
+# path="wire_rlc_sharded" — N shards of MSM work, still exactly one
+# product check per span.
 RLC_NBITS = batch_verify.RLC_SCALAR_BITS
 RLC_LANE_BUCKETS = (8, 32, 128, 512)
 ENGINE_RLC_MIN = int(os.environ.get("DRAND_TPU_ENGINE_RLC_MIN", "8"))
@@ -112,6 +116,24 @@ def _pallas_ok(b: int) -> bool:
     import jax
 
     return b >= PALLAS_MIN_BUCKET and jax.default_backend() == "tpu"
+
+
+def shard_map_unchecked(f, **kw):
+    """``jax.shard_map`` with the replication checker off (a post-gather
+    fold makes every device compute the identical total, which the
+    varying-axes checker can't infer). Handles both jax layouts: the
+    import moved out of experimental and the kwarg was renamed
+    check_rep -> check_vma across releases. Shared by the engine's
+    sharded wire-RLC combine and the driver's mesh dryrun."""
+    try:
+        from jax import shard_map
+    except ImportError:  # jax < 0.8 layout
+        from jax.experimental.shard_map import shard_map
+
+    try:
+        return shard_map(f, check_vma=False, **kw)
+    except TypeError:
+        return shard_map(f, check_rep=False, **kw)
 
 
 def _bucket(n: int, buckets) -> int:
@@ -157,14 +179,18 @@ class BatchedEngine:
     def __init__(self, buckets=DEFAULT_BUCKETS,
                  wire_prep: bool | None = None, mesh=None):
         """``mesh``: an optional 1-axis ``jax.sharding.Mesh``; verify
-        batches whose bucket divides by the mesh size are sharded over
-        the batch axis (data parallel over rounds — SURVEY §5: the
-        chain-catchup verifier sharded across chips with pjit). The same
-        pattern the driver's dryrun_multichip validates."""
+        batches are sharded over the batch axis (data parallel over
+        rounds — SURVEY §5: the chain-catchup verifier sharded across
+        chips with pjit; buckets that don't divide the mesh pad up to
+        it). The same pattern the driver's dryrun_multichip validates.
+        A mesh also arms the SHARDED wire-RLC tier: per-shard device
+        h2c + decompression + lane-MSM with one cross-shard reduction
+        before the single pairing row (see verify_wire_rlc)."""
         self.buckets = tuple(sorted(buckets))
         self.mesh = mesh
         self._verify = jax.jit(pairing.verify_prepared)
         self._verify_sharded = None
+        self._wire_rlc_sharded_jit = None
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -174,6 +200,7 @@ class BatchedEngine:
             self._verify_sharded = jax.jit(
                 pairing.verify_prepared,
                 in_shardings=(shard, shard, shard), out_shardings=shard)
+            self._wire_rlc_sharded_jit = self._make_wire_rlc_sharded()
         self._msm_g2 = jax.jit(
             lambda pts, bits: curve.pt_to_affine(
                 curve.F2, curve.msm(curve.F2, pts, bits)))
@@ -205,7 +232,9 @@ class BatchedEngine:
         self._wire_ok: dict[int, bool] = {}
         self._eval_ok: dict[tuple[int, int], bool] = {}
         self._poly_eval_ok: dict[tuple[int, int], bool] = {}
-        self._agg_ok: dict[tuple[int, int], bool] = {}
+        # keyed (pairing bucket, msm lanes, msm scalar bits) — GLS4 and
+        # full-width aggregates compile different executables per shape
+        self._agg_ok: dict[tuple[int, int, int], bool] = {}
         self._agg_graph_jit = jax.jit(self._agg_graph)
         # RLC fast paths: per-shape KAT cache + jitted graphs. rlc_min /
         # rlc_lane_buckets are instance attrs so tests can shrink them.
@@ -218,6 +247,12 @@ class BatchedEngine:
         # catch-up span needs no host hashing at all (see verify_wire_rlc)
         self._wire_rlc_ok: dict[int, bool] = {}
         self._wire_rlc_jit = jax.jit(self._wire_rlc_graph)
+        self._wire_rlc_sharded_ok: dict[int, bool] = {}
+        # GLS ψ² 4-D scalar split for the recovery/aggregation MSMs:
+        # 255-bit Lagrange scalars become four <= 64-bit digit lanes on
+        # (P, -ψP, ψ²P, -ψ³P) (crypto/endo.py), quartering the device
+        # ladder scan. DRAND_TPU_GLS4=0 reverts to full-width ladders.
+        self.gls4 = os.environ.get("DRAND_TPU_GLS4", "1") != "0"
 
     @staticmethod
     def _wire_graph(pub_aff, sig_x, sig_sign, u_pairs):
@@ -262,6 +297,69 @@ class BatchedEngine:
             curve.F2, curve.msm_lanes(curve.F2, msg_jac, bits))
         return ok, sx, sy, sinf, mx, my, minf
 
+    def _make_wire_rlc_sharded(self):
+        """The wire-RLC combine SHARDED over the batch axis of the
+        1-axis mesh: every shard runs its own decompress + h2c +
+        lane-MSM on b/N lanes, then ONE cross-shard reduction (N-1
+        point-adds over the gathered per-shard partial sums) precedes
+        the affine conversion — so an N-sharded catch-up span is N
+        shards of MSM work and still exactly one pairing row
+        downstream. Same output contract as ``_wire_rlc_graph``; the
+        per-item ``ok`` mask stays sharded, the combined pair comes
+        back replicated."""
+        from jax.sharding import PartitionSpec as P
+
+        axis = self.mesh.axis_names[0]
+        nsh = self._mesh_size
+
+        def local(sig_x, sig_sign, u_pairs, live, bits):
+            from . import h2c
+
+            sig_pt, on_curve = h2c.decompress_g2_device(sig_x, sig_sign)
+            in_subgroup = h2c.subgroup_check_g2(sig_pt)
+            msg_pt = h2c.hash_to_g2_device(u_pairs)
+            ok = on_curve & in_subgroup & live & ~msg_pt[3]
+            dead = ~ok
+            sig_jac = (sig_pt[0], sig_pt[1], sig_pt[2], sig_pt[3] | dead)
+            msg_jac = (msg_pt[0], msg_pt[1], msg_pt[2], msg_pt[3] | dead)
+            s_part = curve.msm_lanes(curve.F2, sig_jac, bits)
+            m_part = curve.msm_lanes(curve.F2, msg_jac, bits)
+
+            def fold(part):
+                # the single cross-shard reduction: gather the N partial
+                # sums and fold them on every device (each then holds
+                # the identical span total — out_specs P() below)
+                gathered = tuple(jax.lax.all_gather(c, axis)
+                                 for c in part)
+                total = tuple(c[0] for c in gathered)
+                for k in range(1, nsh):
+                    total = curve.pt_add(
+                        curve.F2, total, tuple(c[k] for c in gathered))
+                return total
+
+            sx, sy, sinf = curve.pt_to_affine(curve.F2, fold(s_part))
+            mx, my, minf = curve.pt_to_affine(curve.F2, fold(m_part))
+            return ok, sx, sy, sinf, mx, my, minf
+
+        spec = P(axis)
+        return jax.jit(shard_map_unchecked(
+            local, mesh=self.mesh,
+            in_specs=(spec, spec, spec, spec, spec),
+            out_specs=(spec, P(), P(), P(), P(), P(), P())))
+
+    def _wire_rlc_shardable(self, b: int) -> bool:
+        """A combine bucket shards iff it divides evenly over the mesh
+        with a power-of-two per-shard lane count (the local msm_lanes
+        fold needs it); the Mosaic path (TPU) takes precedence — the
+        sharded XLA combine targets the virtual CPU mesh and real
+        multi-chip data parallelism, not single-chip Pallas."""
+        if self.mesh is None or _pallas_ok(b):
+            return False
+        if b % self._mesh_size:
+            return False
+        per_shard = b // self._mesh_size
+        return per_shard >= 1 and not (per_shard & (per_shard - 1))
+
     # ---------------------------------------------------- introspection
     def introspect(self) -> dict:
         """JSON-ready snapshot of the engine's runtime state for
@@ -285,9 +383,13 @@ class BatchedEngine:
             "buckets": list(self.buckets),
             "wire_buckets": list(self._wire_buckets()),
             "wire_rlc_buckets": list(self._wire_rlc_buckets()),
+            "wire_rlc_sharded_buckets": [
+                b for b in self._wire_rlc_buckets()
+                if self._wire_rlc_shardable(b)],
             "rlc_lane_buckets": list(self.rlc_lane_buckets),
             "rlc_min": self.rlc_min,
             "wire_prep": self.wire_prep,
+            "gls4": self.gls4,
             "pallas_min_bucket": PALLAS_MIN_BUCKET,
             "kat": {
                 "verify": {str(b): ok
@@ -298,11 +400,16 @@ class BatchedEngine:
                         in sorted(self._rlc_ok.items())},
                 "wire_rlc": {str(b): ok for b, ok
                              in sorted(self._wire_rlc_ok.items())},
+                # shard-shape key: bucket over mesh lanes per shard
+                "wire_rlc_sharded": {
+                    f"b{b}/m{self._mesh_size}": ok for b, ok
+                    in sorted(self._wire_rlc_sharded_ok.items())}
+                if self.mesh is not None else {},
                 "eval": {f"t{t}/b{b}": ok for (t, b), ok
                          in sorted(self._eval_ok.items())},
                 "poly_eval": {f"t{t}/b{b}": ok for (t, b), ok
                               in sorted(self._poly_eval_ok.items())},
-                "agg": {f"b{b}/msm{m}": ok for (b, m), ok
+                "agg": {f"b{b}/msm{m}/w{nb}": ok for (b, m, nb), ok
                         in sorted(self._agg_ok.items())},
             },
         }
@@ -647,8 +754,16 @@ class BatchedEngine:
 
     def _launch_bucket(self, triples, b: int):
         """Dispatch one padded bucket; returns (device_out, valid, count)
-        WITHOUT synchronizing — callers drain all launches at once."""
+        WITHOUT synchronizing — callers drain all launches at once.
+
+        On a mesh engine the bucket pads UP to the next mesh multiple
+        (extra generator rows masked out via ``valid``, the same trick
+        the wire-RLC combine uses for bad lanes) so the sharded
+        executable always engages — a bucket that doesn't divide the
+        mesh used to drop silently to a single device."""
         n = len(triples)
+        if self.mesh is not None and b % self._mesh_size:
+            b = -(-b // self._mesh_size) * self._mesh_size
         pubs = np.zeros((b, 2, limb.NLIMBS), np.int32)
         sigs = np.zeros((b, 2, 2, limb.NLIMBS), np.int32)
         msgs = np.zeros((b, 2, 2, limb.NLIMBS), np.int32)
@@ -674,8 +789,7 @@ class BatchedEngine:
             sigs[i] = _g2_xy(g2_xy[2 * j])
             msgs[i] = _g2_xy(g2_xy[2 * j + 1])
             valid[i] = True
-        sharded = (self.mesh is not None and b % self._mesh_size == 0
-                   and b >= self._mesh_size)
+        sharded = self.mesh is not None  # b is a mesh multiple by now
         if _pallas_ok(b):
             from . import pallas_pairing
 
@@ -894,15 +1008,34 @@ class BatchedEngine:
                     else n_checks >= PALLAS_MIN_BUCKET)
         return bool(use_wire) and self._rlc_wanted(n_checks)
 
+    def wire_rlc_sharded_active(self, n_checks: int) -> bool:
+        """True iff a span of ``n_checks`` wire checks would run the
+        MESH-sharded wire-RLC combine (the crypto/batch.py dispatcher
+        labels such spans path="wire_rlc_sharded"). Predicted from the
+        bucket geometry alone — reading this never triggers a KAT
+        probe; the per-shard-shape gate still applies at dispatch."""
+        if not self.wire_rlc_active(n_checks):
+            return False
+        buckets = self._wire_rlc_buckets()
+        if not buckets:
+            return False
+        b = next((bb for bb in buckets if bb >= n_checks), buckets[-1])
+        return self._wire_rlc_shardable(b)
+
     def _wire_rlc_buckets(self):
         # the lane-MSM's cross-lane fold needs power-of-two lanes
         return tuple(b for b in self._wire_buckets() if not (b & (b - 1)))
 
-    def _combine_wire_chunk(self, checks, cs, b: int, dst: bytes):
+    def _combine_wire_chunk(self, checks, cs, b: int, dst: bytes,
+                            sharded: bool | None = None):
         """One combine dispatch of <= b wire checks: (decode-ok mask,
         Σc·sig, Σc·H(m)) with host points, (mask, None, None) when no
         lane survives decode, or None when a live combination
-        degenerates to infinity (fall back; ~2^-128 honest)."""
+        degenerates to infinity (fall back; ~2^-128 honest).
+        ``sharded``: force the mesh-sharded / unsharded combine (the
+        KAT probes pin the path they gate); None consults the sharded
+        KAT cache — never probes — so dispatch follows whatever verdict
+        bucket selection already established."""
         from . import h2c
 
         n = len(checks)
@@ -917,10 +1050,17 @@ class BatchedEngine:
         bits = np.zeros((b, RLC_NBITS), np.int32)
         for i, c in enumerate(cs):
             bits[i] = curve.scalar_to_bits(c, RLC_NBITS)
+        if sharded is None:
+            sharded = bool(self._wire_rlc_shardable(b)
+                           and self._wire_rlc_sharded_ok.get(b))
         if _pallas_ok(b):
             from . import pallas_wire
 
             out = pallas_wire.wire_rlc_pl(u, xs, sign, live, bits)
+        elif sharded:
+            out = self._wire_rlc_sharded_jit(
+                jnp.asarray(xs), jnp.asarray(sign), jnp.asarray(u),
+                jnp.asarray(live), jnp.asarray(bits))
         else:
             out = self._wire_rlc_jit(
                 jnp.asarray(xs), jnp.asarray(sign), jnp.asarray(u),
@@ -933,15 +1073,12 @@ class BatchedEngine:
             return None
         return ok, _g2_from_affine_dev(sx, sy), _g2_from_affine_dev(mx, my)
 
-    def _check_wire_rlc(self, b: int) -> bool:
-        """KAT one wire-RLC combine shape against the host MSM on fixed
+    def _wire_rlc_kat_probe(self, b: int, sharded: bool) -> bool:
+        """One wire-RLC combine KAT against the host MSM on fixed
         signatures and scalars, including a malformed lane that must be
         excluded from the combination. Gates usefulness, not soundness
         (the pairing row is the separately-KAT-gated verify_bls bucket,
         and a wrong combined point fails it)."""
-        ok = self._wire_rlc_ok.get(b)
-        if ok is not None:
-            return ok
         from ..crypto import bls
         from ..crypto.hash_to_curve import hash_to_g2
 
@@ -956,19 +1093,25 @@ class BatchedEngine:
             cs.append(3)
             expect_mask.append(False)
         try:
-            got = self._combine_wire_chunk(checks, cs, b, DEFAULT_DST_G2)
+            got = self._combine_wire_chunk(checks, cs, b, DEFAULT_DST_G2,
+                                           sharded=sharded)
             if got is None:
-                ok = False
-            else:
-                mask, s_comb, m_comb = got
-                p1 = PointG2.from_bytes(s1, subgroup_check=False)
-                p2 = PointG2.from_bytes(s2, subgroup_check=False)
-                ok = (list(mask) == expect_mask
-                      and s_comb == p1.mul(5) + p2.mul(7)
-                      and m_comb == hash_to_g2(m1).mul(5)
-                      + hash_to_g2(m2).mul(7))
+                return False
+            mask, s_comb, m_comb = got
+            p1 = PointG2.from_bytes(s1, subgroup_check=False)
+            p2 = PointG2.from_bytes(s2, subgroup_check=False)
+            return (list(mask) == expect_mask
+                    and s_comb == p1.mul(5) + p2.mul(7)
+                    and m_comb == hash_to_g2(m1).mul(5)
+                    + hash_to_g2(m2).mul(7))
         except Exception:  # noqa: BLE001 — trace/lowering failures too
-            ok = False
+            return False
+
+    def _check_wire_rlc(self, b: int) -> bool:
+        ok = self._wire_rlc_ok.get(b)
+        if ok is not None:
+            return ok
+        ok = self._wire_rlc_kat_probe(b, sharded=False)
         self._wire_rlc_ok[b] = ok
         if not ok:
             from ..utils.logging import default_logger
@@ -976,6 +1119,34 @@ class BatchedEngine:
             default_logger("engine").warn(
                 "engine", "wire_rlc_bucket_disabled", bucket=b)
         return ok
+
+    def _check_wire_rlc_sharded(self, b: int) -> bool:
+        """KAT the MESH-sharded combine per shard shape (bucket over
+        mesh) — its own cache and verdict, so a sharded miscompile
+        disables only the sharded executable."""
+        ok = self._wire_rlc_sharded_ok.get(b)
+        if ok is not None:
+            return ok
+        ok = self._wire_rlc_kat_probe(b, sharded=True)
+        self._wire_rlc_sharded_ok[b] = ok
+        if not ok:
+            from ..utils.logging import default_logger
+
+            default_logger("engine").warn(
+                "engine", "wire_rlc_sharded_bucket_disabled", bucket=b,
+                mesh=self._mesh_size if self.mesh is not None else 0)
+        return ok
+
+    def _wire_rlc_check(self, b: int) -> bool:
+        """Bucket-selection gate: shardable buckets are vouched for by
+        the sharded KAT (one compile per shape on a mesh engine); the
+        rest by the single-device combine KAT. A failed sharded KAT
+        makes the bucket unusable for THIS tier — verify_wire_rlc then
+        returns None and the caller decides via the per-item wire graph
+        (false-reject-only, like every other combine failure)."""
+        if self._wire_rlc_shardable(b):
+            return self._check_wire_rlc_sharded(b)
+        return self._check_wire_rlc(b)
 
     def verify_wire_rlc(self, pubkey: PointG1, checks,
                         dst: bytes = DEFAULT_DST_G2) -> np.ndarray | None:
@@ -992,7 +1163,7 @@ class BatchedEngine:
             return np.zeros(0, dtype=bool)
         if pubkey.is_infinity():
             return None
-        b = self._good_bucket(n, check=self._check_wire_rlc,
+        b = self._good_bucket(n, check=self._wire_rlc_check,
                               buckets=self._wire_rlc_buckets())
         if b is None:
             return None
@@ -1340,6 +1511,50 @@ class BatchedEngine:
                 break
         return shares
 
+    def _gls4_active(self, t: int) -> bool:
+        """GLS ψ² 4-D split for a t-share recovery MSM: always on the
+        shape-flexible XLA paths (CPU / small buckets); on TPU only
+        while the four digit lanes per share still fit the Mosaic
+        kernel's fixed LANES width — beyond that the full-width Pallas
+        ladder stays the better program."""
+        if not self.gls4:
+            return False
+        if jax.default_backend() != "tpu":
+            return True
+        from . import pallas_msm
+
+        return 4 * t <= pallas_msm.LANES
+
+    @staticmethod
+    def _pack_msm_gls4(shares, lambdas, b: int):
+        """GLS-split MSM packing: each share expands to its four ψ-basis
+        lanes (P, -ψP, ψ²P, -ψ³P) with the base-M digits of its Lagrange
+        coefficient as scalars (crypto/endo.gls4_*), so the device
+        ladder runs GLS4_DIGIT_BITS-step scans instead of 255. The
+        basis points come straight off the batch-normalized affine
+        coordinates — two Fp2 multiplications per lane, no inversions.
+        Returns (pts (b,2,2,L), inf (b,), bits (b, GLS4_DIGIT_BITS))."""
+        from ..crypto import endo
+
+        pad = _g2_aff(PointG2.generator())
+        pts_np = np.broadcast_to(pad, (b, 2, 2, limb.NLIMBS)).copy()
+        inf = np.ones(b, dtype=bool)  # padding rows masked out as infinity
+        nbits = endo.GLS4_DIGIT_BITS
+        bits = np.zeros((b, nbits), np.int32)
+        share_xy = PointG2.batch_to_affine([s.value for s in shares])
+        for i, s in enumerate(shares):
+            digits = endo.gls4_decompose(lambdas[s.index] % R)
+            basis = endo.gls4_points_from_affine(*share_xy[i])
+            for k, d in enumerate(digits):
+                lane = 4 * i + k
+                if not d:
+                    continue  # zero digit: lane stays masked infinity
+                # basis points carry z == 1: (X, Y) are affine already
+                pts_np[lane] = _g2_xy((basis[k].X, basis[k].Y))
+                inf[lane] = False
+                bits[lane] = curve.scalar_to_bits(d, nbits)
+        return pts_np, inf, bits
+
     def recover(self, pub_poly: PubPoly, msg: bytes, partials, t: int, n: int,
                 dst: bytes = DEFAULT_DST_G2, *, shares=None) -> bytes:
         """Lagrange-recover the full signature on device: one G2 MSM with
@@ -1347,30 +1562,46 @@ class BatchedEngine:
         chain/beacon/chain.go:136). Same selection semantics as the host
         tbls.recover: first t distinct valid indices win. ``shares``:
         pre-selected PubShares (internal callers that already decoded
-        the partials skip the duplicate decode+subgroup pass)."""
+        the partials skip the duplicate decode+subgroup pass).
+
+        The scalars run GLS-split by default (``self.gls4``): four
+        <= 64-bit digit lanes per share instead of one 255-bit ladder —
+        a quarter of the sequential scan every threshold-aggregation
+        round pays, not just catch-up (ROADMAP #5)."""
         if shares is None:
             shares = self._select_shares(partials, t, n)
         if len(shares) < t:
             raise ValueError(f"not enough valid partials: {len(shares)} < {t}")
         lambdas = lagrange_coefficients([s.index for s in shares])
-        # buckets bound the PAIRING batch shapes; the MSM must still fit
-        # all t shares even when a custom engine's top bucket is smaller
-        b = max(_bucket(t, self.buckets), t)
-        use_lanes = jax.default_backend() == "tpu" and b > self.PIPPENGER_MIN_T
-        if use_lanes and b & (b - 1):
-            # msm_lanes' log-tree fold needs power-of-two lanes; a custom
-            # BatchedEngine(buckets=...) may hand us any size — pad up,
-            # the extra rows are masked infinity (ADVICE r3)
-            b = 1 << (b - 1).bit_length()
-        pad = _g2_aff(PointG2.generator())
-        pts_np = np.broadcast_to(pad, (b, 2, 2, limb.NLIMBS)).copy()
-        inf = np.ones(b, dtype=bool)  # padding rows masked out as infinity
-        bits = np.zeros((b, 255), np.int32)
-        for i, s in enumerate(shares):
-            pts_np[i] = _g2_aff(s.value)
-            inf[i] = False
-            bits[i] = curve.scalar_to_bits(lambdas[s.index] % R, 255)
         from . import pallas_msm
+
+        if self._gls4_active(len(shares)):
+            b = max(8, 1 << (4 * len(shares) - 1).bit_length())
+            if jax.default_backend() == "tpu":
+                b = max(b, pallas_msm.LANES)  # keep the Mosaic MSM engaged
+            pts_np, inf, bits = self._pack_msm_gls4(shares, lambdas, b)
+            use_lanes = (jax.default_backend() == "tpu"
+                         and b > self.PIPPENGER_MIN_T)
+        else:
+            # buckets bound the PAIRING batch shapes; the MSM must still
+            # fit all t shares even when a custom engine's top bucket is
+            # smaller
+            b = max(_bucket(t, self.buckets), t)
+            use_lanes = (jax.default_backend() == "tpu"
+                         and b > self.PIPPENGER_MIN_T)
+            if use_lanes and b & (b - 1):
+                # msm_lanes' log-tree fold needs power-of-two lanes; a
+                # custom BatchedEngine(buckets=...) may hand us any size —
+                # pad up, the extra rows are masked infinity (ADVICE r3)
+                b = 1 << (b - 1).bit_length()
+            pad = _g2_aff(PointG2.generator())
+            pts_np = np.broadcast_to(pad, (b, 2, 2, limb.NLIMBS)).copy()
+            inf = np.ones(b, dtype=bool)  # padding masked out as infinity
+            bits = np.zeros((b, 255), np.int32)
+            for i, s in enumerate(shares):
+                pts_np[i] = _g2_aff(s.value)
+                inf[i] = False
+                bits[i] = curve.scalar_to_bits(lambdas[s.index] % R, 255)
 
         if use_lanes and b == pallas_msm.LANES:
             # one fused Mosaic program: per-lane ladders + lane-roll fold
@@ -1378,7 +1609,8 @@ class BatchedEngine:
             # by every caller (VerifyRecovered), so correctness cannot
             # silently degrade to an accepted wrong signature.
             x_aff, y_aff, is_inf = pallas_msm.msm_g2_pl(
-                pts_np[:, 0], pts_np[:, 1], inf, bits)
+                pts_np[:, 0], pts_np[:, 1], inf, bits,
+                nbits=bits.shape[1])
         else:
             z_one = np.zeros((b, 2, limb.NLIMBS), np.int32)
             z_one[:, 0] = np.asarray(limb.ONE_MONT)
@@ -1416,8 +1648,10 @@ class BatchedEngine:
                 and mx.shape[0] == pallas_msm.LANES):
             # Mosaic MSM: keeps the whole fused graph on the Pallas path
             # (the plain-XLA limb MSM between Mosaic kernels is the known
-            # libtpu-flaky regime)
-            rx, ry, rinf = pallas_msm.msm_g2_pl(mx, my, minf, mbits)
+            # libtpu-flaky regime). nbits follows the packing — 255 for
+            # full-width ladders, GLS4_DIGIT_BITS for the ψ² split.
+            rx, ry, rinf = pallas_msm.msm_g2_pl(mx, my, minf, mbits,
+                                                nbits=mbits.shape[-1])
         else:
             rx, ry, rinf = curve.pt_to_affine(
                 curve.F2, curve.msm_lanes(curve.F2, (mx, my, mz, minf),
@@ -1464,11 +1698,14 @@ class BatchedEngine:
                 out.append(pub_poly.eval(tbls.index_of(p)).value)
         return out
 
-    def _check_agg_bucket(self, b: int, b_msm: int) -> bool:
-        """KAT-gate the fused executable per (bucket, msm-lane) shape —
-        same axon-miscompile discipline as every other graph family: a
-        toy 2-of-3 group whose recovery and verdicts are known on host."""
-        key = (b, b_msm)
+    def _check_agg_bucket(self, b: int, b_msm: int, nbits: int) -> bool:
+        """KAT-gate the fused executable per (bucket, msm-lane, msm-bit)
+        shape — same axon-miscompile discipline as every other graph
+        family: a toy 2-of-3 group whose recovery and verdicts are known
+        on host. The probe packs the SAME scalar decomposition the
+        dispatch will (GLS4 digit lanes vs full-width), so the verdict
+        vouches for the executable that actually runs."""
+        key = (b, b_msm, nbits)
         ok = self._agg_ok.get(key)
         if ok is not None:
             return ok
@@ -1482,7 +1719,8 @@ class BatchedEngine:
             bad = parts[2][:tbls.INDEX_BYTES] + parts[1][tbls.INDEX_BYTES:]
             expect_sig = tbls.recover(pub_poly, msg, parts[:2], 2, 3)
             oks, rec = self._run_agg(pub_poly, msg, parts[:2] + [bad],
-                                     2, 3, DEFAULT_DST_G2, b, b_msm)
+                                     2, 3, DEFAULT_DST_G2, b, b_msm,
+                                     gls4=nbits != 255)
             ok = (oks == [True, True, False] and rec == expect_sig)
         except Exception:  # noqa: BLE001 — trace/lowering failures too
             ok = False
@@ -1491,7 +1729,8 @@ class BatchedEngine:
             from ..utils.logging import default_logger
 
             default_logger("engine").warn(
-                "engine", "agg_bucket_disabled", bucket=b, msm_lanes=b_msm)
+                "engine", "agg_bucket_disabled", bucket=b, msm_lanes=b_msm,
+                msm_bits=nbits)
         return ok
 
     def aggregate_round(self, pub_poly: PubPoly, msg: bytes, partials,
@@ -1518,14 +1757,15 @@ class BatchedEngine:
                                     shares)
             if got is not None:
                 return got
-        b, b_msm = self.agg_shape(npart, t)
-        if npart + 1 > b or not self._check_agg_bucket(b, b_msm):
+        b, b_msm, msm_nbits = self.agg_shape(npart, t)
+        if npart + 1 > b or not self._check_agg_bucket(b, b_msm, msm_nbits):
             oks = self.verify_partials(pub_poly, msg, partials, dst)
             return oks, self._recover_verified(pub_poly, msg, partials, oks,
                                                t, n, dst)
         _meter_rows(npart + 1)
         oks, rec = self._run_agg(pub_poly, msg, partials, t, n, dst,
-                                 b, b_msm, shares=shares)
+                                 b, b_msm, shares=shares,
+                                 gls4=msm_nbits != 255)
         chosen = {s.index for s in shares}
         chosen_ok = all(
             ok for p, ok in zip(partials, oks)
@@ -1538,11 +1778,25 @@ class BatchedEngine:
         return oks, self._recover_verified(pub_poly, msg, partials, oks,
                                            t, n, dst)
 
-    def agg_shape(self, npart: int, t: int) -> tuple[int, int]:
-        """(pairing bucket, msm lanes) the fused round would use — the
-        KAT cache key shape."""
+    def agg_shape(self, npart: int, t: int) -> tuple[int, int, int]:
+        """(pairing bucket, msm lanes, msm scalar bits) the fused round
+        would use — the KAT cache key shape. GLS-split rounds pack four
+        digit lanes per share with GLS4_DIGIT_BITS scalars, full-width
+        rounds one 255-bit lane per share; the bit width is part of the
+        key because the two compile DIFFERENT executables even at equal
+        lane counts."""
+        if self._gls4_active(t):
+            from ..crypto import endo
+
+            b_msm = max(8, 1 << (4 * t - 1).bit_length())
+            if jax.default_backend() == "tpu":
+                from . import pallas_msm
+
+                b_msm = max(b_msm, pallas_msm.LANES)
+            return (_bucket(npart + 1, self.buckets), b_msm,
+                    endo.GLS4_DIGIT_BITS)
         return (_bucket(npart + 1, self.buckets),
-                max(8, 1 << (t - 1).bit_length()))
+                max(8, 1 << (t - 1).bit_length()), 255)
 
     def agg_fused_active(self, npart: int, t: int) -> bool:
         """True iff an (npart, t) aggregate_round runs the single-dispatch
@@ -1607,9 +1861,12 @@ class BatchedEngine:
         return sig
 
     def _run_agg(self, pub_poly, msg, partials, t, n, dst, b, b_msm,
-                 shares=None):
+                 shares=None, gls4=None):
         """Pack, dispatch and unpack one fused round; returns (oks, sig
-        bytes | None-if-recovered-infinity)."""
+        bytes | None-if-recovered-infinity). ``gls4`` pins the MSM
+        packing (aggregate_round passes agg_shape's decision so the KAT
+        and the dispatch compile the same executable); None falls back
+        to the engine policy."""
         npart = len(partials)
         msg_pt = self._hash_msg(msg, dst)
         pubkeys = self._share_pubkeys(pub_poly, partials)
@@ -1652,16 +1909,22 @@ class BatchedEngine:
         slot_mask = np.zeros(b, dtype=bool)
         slot_mask[slot] = True
 
-        # MSM lanes (same packing as recover(), b_msm power-of-two)
-        pad = _g2_aff(PointG2.generator())
-        pts_np = np.broadcast_to(pad, (b_msm, 2, 2, limb.NLIMBS)).copy()
-        inf = np.ones(b_msm, dtype=bool)
-        bits = np.zeros((b_msm, 255), np.int32)
-        share_xy = PointG2.batch_to_affine([s.value for s in shares])
-        for i, s in enumerate(shares):
-            pts_np[i] = _g2_xy(share_xy[i])
-            inf[i] = False
-            bits[i] = curve.scalar_to_bits(lambdas[s.index] % R, 255)
+        # MSM lanes (same packing as recover(), b_msm power-of-two):
+        # GLS-split digit lanes when active, full 255-bit ladders else
+        if gls4 is None:
+            gls4 = self._gls4_active(len(shares))
+        if gls4:
+            pts_np, inf, bits = self._pack_msm_gls4(shares, lambdas, b_msm)
+        else:
+            pad = _g2_aff(PointG2.generator())
+            pts_np = np.broadcast_to(pad, (b_msm, 2, 2, limb.NLIMBS)).copy()
+            inf = np.ones(b_msm, dtype=bool)
+            bits = np.zeros((b_msm, 255), np.int32)
+            share_xy = PointG2.batch_to_affine([s.value for s in shares])
+            for i, s in enumerate(shares):
+                pts_np[i] = _g2_xy(share_xy[i])
+                inf[i] = False
+                bits[i] = curve.scalar_to_bits(lambdas[s.index] % R, 255)
         z_one = np.zeros((b_msm, 2, limb.NLIMBS), np.int32)
         z_one[:, 0] = np.asarray(limb.ONE_MONT)
 

@@ -109,10 +109,14 @@ class TestEngineVsHost:
         oks, sig = engine.aggregate_round(pub, msg, partials, 2, 3)
         assert oks == [True] * 3
         assert sig == tbls.recover(pub, msg, partials, 2, 3)
-        # the fused executable (bucket 4, 8 msm lanes) must have passed
-        # its KAT — i.e. this went through ONE dispatch, not the fallback
-        assert engine.agg_shape(3, 2) == (4, 8)
-        assert engine._agg_ok.get((4, 8)) is True
+        # the fused executable (bucket 4, 8 msm lanes — the GLS4 split
+        # packs 4 digit lanes per share at 64-bit width) must have
+        # passed its KAT — i.e. this went through ONE dispatch, not the
+        # fallback
+        from drand_tpu.crypto.endo import GLS4_DIGIT_BITS
+
+        assert engine.agg_shape(3, 2) == (4, 8, GLS4_DIGIT_BITS)
+        assert engine._agg_ok.get((4, 8, GLS4_DIGIT_BITS)) is True
 
     def test_aggregate_round_bad_chosen_partial(self, engine,
                                                 threshold_setup):
@@ -219,8 +223,14 @@ async def test_beacon_network_with_device_engine(device_mode):
     net = BeaconTestNetwork(n=3, t=2, period=2)
     await net.start_all()
     await net.advance_to_genesis()
-    await net.advance_rounds(3)
-    await net.wait_round(0, 3)
+    # per-round lockstep (the test_beacon_engine idiom): aggregation runs
+    # off-loop in a thread, so each round must land before the fake clock
+    # moves on — advancing several periods at once parks every node in the
+    # catchup breather, which sleeps on the (now idle) fake clock forever
+    for r in range(1, 4):
+        for i in range(3):
+            await net.wait_round(i, r, timeout=120)
+        await net.advance_rounds(1)
     net.stop_all()
     pubkey = net.group.public_key.key()
     for node in net.nodes:

@@ -46,3 +46,41 @@ def test_bp_clearing_equals_generic():
     # and the cleared point is in the subgroup
     assert endo.clear_cofactor_fast(
         _pre_clearing_point(b"clear-final")).in_subgroup()
+
+
+def test_psi3_is_psi_cubed():
+    for _ in range(2):
+        g = PointG2.generator().mul(rng.randrange(1, R))
+        assert endo.psi3(g) == endo.psi(endo.psi(endo.psi(g)))
+        assert endo.psi3(g) == endo.psi(endo.psi2(g))
+
+
+def test_gls4_decompose_digit_bounds_and_value():
+    M = endo.GLS4_M
+    for c in (0, 1, M - 1, M, M + 1, M * M, R - 1, (1 << 255) - 19,
+              rng.randrange(1 << 255)):
+        d = endo.gls4_decompose(c)
+        assert len(d) == 4
+        assert all(0 <= dk < M for dk in d)
+        assert all(dk.bit_length() <= endo.GLS4_DIGIT_BITS for dk in d)
+        got = sum(dk * M ** k for k, dk in enumerate(d))
+        assert got == c % R
+
+
+def test_gls4_basis_realizes_digit_multiplication():
+    """Σ d_k · basis_k == c·P on the subgroup, for edge and random
+    scalars — the identity the GLS-split recover ladders rely on."""
+    g = PointG2.generator().mul(rng.randrange(1, R))
+    basis = endo.gls4_points_from_affine(*g.to_affine())
+    assert basis[0] == g
+    M = endo.GLS4_M
+    # the basis points ARE the [M^k] multiples
+    assert basis[1] == -endo.psi(g)
+    assert basis[2] == endo.psi2(g)
+    assert basis[3] == -endo.psi3(g)
+    for c in (1, M - 1, M + 1, R - 1, rng.randrange(1 << 255)):
+        d = endo.gls4_decompose(c)
+        acc = PointG2.infinity()
+        for b, dk in zip(basis, d):
+            acc = acc + b.mul(dk)
+        assert acc == g.mul(c % R), c

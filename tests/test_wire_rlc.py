@@ -100,6 +100,47 @@ class TestHostMSM:
                 acc = acc + q.mul(s)
             assert acc == p.mul(c)
 
+    def test_gls4_edge_matrix_vs_window(self):
+        """The ψ² 4-D split extends msm to full-width scalars: edge
+        values (0, 1, M±1, 2^255−19-adjacent, group-order−1) and random
+        255-bit scalars are value-identical to the 255-bit windowed
+        ladder (ISSUE 8: wide scalars reduce mod r first — same group
+        element either way)."""
+        import random
+
+        from drand_tpu.crypto import endo
+        from drand_tpu.crypto.fields import R
+
+        rng = random.Random(0x615)
+        g2 = PointG2.generator()
+        M = endo.GLS4_M
+        pts = [g2.mul(k + 3) for k in range(10)]
+        scs = [0, 1, M - 1, M, M + 1, R - 1, (1 << 255) - 19,
+               (1 << 255) - 18, rng.randrange(1 << 255),
+               rng.randrange(1 << 254)]
+        assert batch_verify.msm(pts, scs) == \
+            batch_verify.msm_window(pts, scs, nbits=255)
+        # a span wide enough for the bucket branch post-split
+        pts = [g2.mul(rng.randrange(1, 1 << 60)) for _ in range(24)]
+        scs = [rng.randrange(1 << 255) for _ in range(24)]
+        assert batch_verify.msm(pts, scs) == \
+            batch_verify.msm_window(pts, scs, nbits=255)
+
+    def test_gls4_split_reconstructs_scalar(self):
+        from drand_tpu.crypto import endo
+        from drand_tpu.crypto.fields import R
+
+        g2 = PointG2.generator()
+        M = endo.GLS4_M
+        for c in (1, M, M - 1, R - 1, (1 << 255) - 19):
+            p = g2.mul(11)
+            pts, scs = batch_verify._endo_split4_g2([p], [c])
+            acc = PointG2.infinity()
+            for q, s in zip(pts, scs):
+                assert s.bit_length() <= endo.GLS4_DIGIT_BITS
+                acc = acc + q.mul(s)
+            assert acc == p.mul(c % R)
+
 
 # ---------------------------------------------------------------------------
 # Host: batched 4-pairing bisection

@@ -533,19 +533,21 @@ def test_engine_introspect_json_shape():
         eng.rlc_min = 8
         eng.rlc_lane_buckets = (8, 32)
         eng.wire_prep = None
+        eng.gls4 = True
         eng._bucket_ok = {4: True}
         eng._wire_ok = {128: False}
         eng._rlc_ok = {("g2g2", 8): True}
         eng._wire_rlc_ok = {32: True}
+        eng._wire_rlc_sharded_ok = {}
         eng._eval_ok = {(2, 32): True}
         eng._poly_eval_ok = {}
-        eng._agg_ok = {(4, 8): False}
+        eng._agg_ok = {(4, 8, 255): False}
     data = eng.introspect()
     _json.dumps(data)  # every key/value serializes
     assert data["backend"]
     kat = data["kat"]
-    assert set(kat) == {"verify", "wire", "rlc", "wire_rlc", "eval",
-                        "poly_eval", "agg"}
+    assert set(kat) == {"verify", "wire", "rlc", "wire_rlc",
+                        "wire_rlc_sharded", "eval", "poly_eval", "agg"}
     for family in kat.values():
         for k, v in family.items():
             assert isinstance(k, str) and isinstance(v, bool)

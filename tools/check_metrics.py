@@ -51,8 +51,11 @@ _SAMPLE_SUFFIXES = ("_total", "_bucket", "_sum", "_count")
 # _error/_invalid suffixes are appended dynamically on failure paths.
 # "wire_rlc" is the device wire-pipeline RLC tier (ops/engine.py
 # verify_wire_rlc: device hash-to-curve + in-graph lane-MSM, 2 Miller
-# pairs per catch-up span).
-KNOWN_ENGINE_PATHS = {"host", "device", "host_rlc", "wire_rlc"}
+# pairs per catch-up span); "wire_rlc_sharded" is the same tier with
+# the combine sharded over the batch axis of the engine mesh (one
+# cross-shard reduction, still one pairing row per span).
+KNOWN_ENGINE_PATHS = {"host", "device", "host_rlc", "wire_rlc",
+                      "wire_rlc_sharded"}
 # known label VALUES per labelled counter whose cardinality is a fixed
 # enum (new values need a deliberate catalogue update here + README)
 KNOWN_LABEL_VALUES = {"hash_to_g2_cache_requests": {"result": {"hit",
