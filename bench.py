@@ -568,6 +568,57 @@ def bench_msm_glv4(trials):
             "vs_baseline": None}
 
 
+def bench_timelock_throughput(trials):
+    """Timelock round-open A/B on a 64-ciphertext round: the
+    shared-signature batch decryptor (crypto/timelock.decrypt_batch —
+    what the vault's round-boundary open runs on host) vs a sequential
+    ``timelock.decrypt`` loop (the per-item oracle a naive server would
+    run). Pure host crypto, runs FIRST before backend init — the win is
+    reportable with the tunnel down (the PR-5 msm_pippenger_speedup
+    pattern). The batch tier decodes + canonical-folds the round
+    signature once and precomputes the Miller line schedule; the
+    sequential loop pays all of it per ciphertext."""
+    from drand_tpu.chain.beacon import message_v2
+    from drand_tpu.crypto import bls
+    from drand_tpu.crypto import timelock as tl
+    from drand_tpu.crypto.curves import PointG1
+
+    span, round_no = 64, 1000
+    sk, pub = bls.keygen(seed=b"bench-timelock")
+    ident = message_v2(round_no)
+    sig_bytes = bls.sign(sk, ident)
+    cts = [tl.encrypt(pub, ident, b"sealed-bid-%03d" % i)
+           for i in range(span)]
+    # warm the comb table + caches outside the timed regions, and pin
+    # correctness: every batch outcome must equal the oracle's
+    ref = tl.decrypt(sig_bytes, cts[0])
+    outs = tl.decrypt_batch(sig_bytes, cts)
+    if not all(ok for ok, _, _ in outs) or outs[0][1] != ref:
+        raise RuntimeError("batch decrypt disagrees with the oracle")
+
+    def timed_seq():
+        t0 = time.perf_counter()
+        for ct in cts:
+            tl.decrypt(sig_bytes, ct)
+        return time.perf_counter() - t0
+
+    def timed_batch():
+        t0 = time.perf_counter()
+        tl.decrypt_batch(sig_bytes, cts)
+        return time.perf_counter() - t0
+
+    trials = min(trials, 2)
+    dt_seq = best_of(trials, timed_seq)
+    dt_batch = best_of(trials, timed_batch)
+    return {"metric": "timelock_throughput",
+            "value": round(dt_seq / dt_batch, 2), "unit": "x",
+            "span": span,
+            "sequential_seconds": round(dt_seq, 3),
+            "batch_seconds": round(dt_batch, 3),
+            "batch_cts_per_sec": round(span / dt_batch, 1),
+            "vs_baseline": None}
+
+
 def bench_sharded_catchup(budget_left):
     """Mesh-sharded wire-RLC catch-up on the virtual CPU mesh, driven
     through the driver's dryrun_multichip (per-shard device h2c +
@@ -747,8 +798,8 @@ def main() -> None:
     t_start = time.perf_counter()
     which = os.environ.get(
         "BENCH_CONFIGS",
-        "msm,glv4,rlc,obs,shard,e2e,catchup,recover,deal,replay,"
-        "headline").split(",")
+        "msm,glv4,rlc,obs,timelock,shard,e2e,catchup,recover,deal,"
+        "replay,headline").split(",")
 
     # --- outage-proofing (round-3 lesson: the official record must never
     # be an unparseable traceback). Two layers:
@@ -847,6 +898,17 @@ def main() -> None:
 
             log(traceback.format_exc())
             diag("aux_config_failed", config="obs",
+                 error=f"{type(e).__name__}: {e}")
+
+    if "timelock" in which:
+        log("== timelock shared-sig batch decrypt speedup (64-ct round) ==")
+        try:
+            emit(bench_timelock_throughput(trials))
+        except Exception as e:  # noqa: BLE001 — best-effort aux config
+            import traceback
+
+            log(traceback.format_exc())
+            diag("aux_config_failed", config="timelock",
                  error=f"{type(e).__name__}: {e}")
 
     if "shard" in which:

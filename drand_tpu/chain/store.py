@@ -277,6 +277,7 @@ class DiscrepancyStore(WrappedStore):
         from .. import metrics
         from ..obs import export as obs_export
         from ..obs.health import HEALTH
+        from ..timelock import service as timelock_service
         from . import time_math
 
         now = self._clock.now()
@@ -290,6 +291,10 @@ class DiscrepancyStore(WrappedStore):
                              self._group.genesis_time, b.round)
         obs_export.note_round_complete(b.round,
                                        self._group.get_genesis_seed())
+        # round-boundary hook for the timelock vault (drand_tpu/timelock):
+        # a registered service opens the round's pending ciphertexts in
+        # one batched dispatch — a no-op when no vault is serving
+        timelock_service.note_round_complete(b)
 
 
 class CallbackStore(WrappedStore):

@@ -127,7 +127,8 @@ async def _run_daemon(args) -> None:
     http_task = None
     if args.public_listen:
         http_task = asyncio.ensure_future(
-            _serve_public(d, args.public_listen, logger))
+            _serve_public(d, args.public_listen, logger, folder,
+                          timelock=not args.no_timelock))
     await control.wait_shutdown()
     if http_task:
         http_task.cancel()
@@ -135,7 +136,8 @@ async def _run_daemon(args) -> None:
     await control.stop()
 
 
-async def _serve_public(d, listen: str, logger) -> None:
+async def _serve_public(d, listen: str, logger, folder: str,
+                        timelock: bool = True) -> None:
     """Start the REST API once the beacon exists (daemon may still be
     pre-DKG at boot)."""
     from ..client.direct import DirectClient
@@ -152,11 +154,23 @@ async def _serve_public(d, listen: str, logger) -> None:
             raise ValueError(f"{addr} is not a group member")
         return await d.client.peer_metrics(addr)
 
-    server = PublicServer(DirectClient(d.beacon), logger=logger.named("http"),
+    client = DirectClient(d.beacon)
+    tl_service = None
+    if timelock:
+        # the timelock vault rides the public API by default: pending
+        # ciphertexts persist next to the chain db and reopen on restart
+        from ..timelock import TimelockService, TimelockVault
+
+        db = os.path.join(folder, "db", "timelock.db")
+        os.makedirs(os.path.dirname(db), exist_ok=True)
+        tl_service = TimelockService(TimelockVault(db), client,
+                                     logger=logger.named("timelock"))
+    server = PublicServer(client, logger=logger.named("http"),
                           peer_metrics_fn=peer_metrics,
-                          enable_pprof=os.environ.get("DRAND_TPU_PPROF") == "1")
+                          enable_pprof=os.environ.get("DRAND_TPU_PPROF") == "1",
+                          timelock_service=tl_service)
     await server.start(host or "0.0.0.0", int(port))
-    logger.info("http", "serving", listen=listen)
+    logger.info("http", "serving", listen=listen, timelock=timelock)
     await asyncio.Event().wait()
 
 
@@ -575,7 +589,15 @@ def cmd_relay(args) -> None:
 
         sources = [HTTPClient(u) for u in args.url.split(",")]
         client = new_client(sources, **_client_trust(args))
-        server = PublicServer(client)
+        tl_service = None
+        if args.timelock_db:
+            # a relay can front the timelock vault too: it opens rounds
+            # from its verified watch stream (no local chain store)
+            from ..timelock import TimelockService, TimelockVault
+
+            tl_service = TimelockService(TimelockVault(args.timelock_db),
+                                         client)
+        server = PublicServer(client, timelock_service=tl_service)
         host, port = args.listen.rsplit(":", 1)
         await server.start(host or "0.0.0.0", int(port))
         print(f"relay serving {args.listen} from {args.url}", flush=True)
@@ -631,6 +653,109 @@ def cmd_client(args) -> None:
                                  indent=2))
         finally:
             await client.close()
+
+    asyncio.run(run())
+
+
+def _read_payload(args) -> bytes:
+    """The plaintext to lock: --data literal, --in file, else stdin."""
+    if args.data is not None:
+        return args.data.encode()
+    if getattr(args, "infile", None):
+        with open(args.infile, "rb") as f:
+            return f.read()
+    return sys.stdin.buffer.read()
+
+
+def _timelock_round(args, info) -> int:
+    """Round-or-duration addressing (chain/time_math.py): --round wins;
+    --duration D locks to the first round whose boundary is at least D
+    seconds away."""
+    import time as _time
+
+    from ..chain import time_math
+
+    if args.round:
+        return args.round
+    if not args.duration:
+        raise SystemExit("timelock lock needs --round R or --duration "
+                         "SECONDS")
+    now = int(_time.time())
+    target = now + args.duration
+    rd = time_math.current_round(target, info.period, info.genesis_time) + 1
+    if time_math.time_of_round(info.period, info.genesis_time, rd) == \
+            time_math.TIME_OF_ROUND_ERROR_VALUE:
+        raise SystemExit(f"--duration {args.duration} overflows the "
+                         f"chain's round arithmetic")
+    return rd
+
+
+def cmd_timelock(args) -> None:
+    """Timelock client surface: lock (encrypt to a round), unlock
+    (decrypt with the published beacon), submit/status (the serving
+    vault's POST /timelock + GET /timelock/{id})."""
+
+    async def run():
+        import aiohttp
+
+        from ..client import timelock as client_timelock
+        from ..client.http import HTTPClient
+
+        src = HTTPClient(args.url)
+        try:
+            if args.what == "lock":
+                info = await src.info()
+                rd = _timelock_round(args, info)
+                env = await asyncio.to_thread(
+                    client_timelock.encrypt_to_round, info, rd,
+                    _read_payload(args))
+                print(client_timelock.dumps(env))
+                return
+            if args.what == "unlock":
+                with open(args.ct, "r") as f:
+                    env = client_timelock.loads(f.read())
+                info = await src.info()
+                result = await src.get(env.get("round", 0))
+                out = await asyncio.to_thread(
+                    client_timelock.decrypt_with_beacon, env, result,
+                    info)
+                sys.stdout.buffer.write(out)
+                sys.stdout.buffer.flush()
+                return
+            async def read_body(r):
+                # the error path may not be our JSON (proxy HTML, a
+                # --no-timelock node's text/plain 404): never let
+                # ContentTypeError replace the clean failure message
+                text = await r.text()
+                try:
+                    return json.loads(text)
+                except ValueError:
+                    return {"error": text.strip()[:200]}
+
+            base = args.url.rstrip("/")
+            async with aiohttp.ClientSession() as s:
+                if args.what == "submit":
+                    with open(args.ct, "r") as f:
+                        env = client_timelock.loads(f.read())
+                    async with s.post(f"{base}/timelock", json=env) as r:
+                        body = await read_body(r)
+                        if r.status not in (200, 202):
+                            raise SystemExit(
+                                f"submit failed (HTTP {r.status}): "
+                                f"{body.get('error', body)}")
+                        print(json.dumps(body, indent=2))
+                else:  # status
+                    if not args.id:
+                        raise SystemExit("timelock status requires --id")
+                    async with s.get(f"{base}/timelock/{args.id}") as r:
+                        body = await read_body(r)
+                        if r.status != 200:
+                            raise SystemExit(
+                                f"status failed (HTTP {r.status}): "
+                                f"{body.get('error', body)}")
+                        print(json.dumps(body, indent=2))
+        finally:
+            await src.close()
 
     asyncio.run(run())
 
@@ -763,6 +888,9 @@ def main(argv=None) -> None:
     s.add_argument("--private-listen")
     s.add_argument("--public-listen")
     s.add_argument("--control", type=int, default=8888)
+    s.add_argument("--no-timelock", action="store_true",
+                   help="serve the public API without the timelock vault "
+                        "(on by default at <folder>/db/timelock.db)")
     s.add_argument("--dkg-timeout", type=float, default=10.0)
     s.add_argument("--tls", action="store_true",
                    help="serve the node port over TLS (self-signed cert "
@@ -855,7 +983,32 @@ def main(argv=None) -> None:
                    help="hex chain hash to pin (verifies all beacons)")
     r.add_argument("--insecure", action="store_true",
                    help="explicitly skip beacon verification")
+    r.add_argument("--timelock-db", default="",
+                   help="serve the timelock vault from this sqlite path "
+                        "(opens rounds off the verified watch stream)")
     r.set_defaults(fn=cmd_relay)
+
+    tl = sub.add_parser("timelock",
+                        help="timelock client: encrypt to a future round, "
+                             "decrypt with its beacon, or use a node's "
+                             "vault (POST /timelock)")
+    tl.add_argument("what", choices=["lock", "unlock", "submit", "status"])
+    tl.add_argument("--url", required=True,
+                    help="public HTTP base URL of a node/relay")
+    tl.add_argument("--round", type=int, default=0,
+                    help="lock: target round (exclusive with --duration)")
+    tl.add_argument("--duration", type=int, default=0,
+                    help="lock: seconds until the ciphertext may open "
+                         "(rounded up to the next round boundary)")
+    tl.add_argument("--data", default=None,
+                    help="lock: literal payload (else --in / stdin)")
+    tl.add_argument("--in", dest="infile", default="",
+                    help="lock: read the payload from this file")
+    tl.add_argument("--ct", default="",
+                    help="unlock/submit: envelope JSON file (from lock)")
+    tl.add_argument("--id", default="",
+                    help="status: ciphertext id returned by submit")
+    tl.set_defaults(fn=cmd_timelock)
 
     c = sub.add_parser("client")
     c.add_argument("--url", default="", help="comma-separated HTTP origins")

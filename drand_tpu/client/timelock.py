@@ -4,6 +4,18 @@ The fork-specific headline feature (SURVEY.md: core/timelock_test.go:17-72):
 the unchained V2 signature over H(round) acts as an IBE private key for
 identity = MessageV2(round), so anyone can encrypt a message that becomes
 decryptable exactly when the network publishes that round.
+
+Envelope format (JSON, scheme version 1):
+
+    {"v": 1, "round": N, "chain_hash": <hex>,
+     "U": <hex G1>, "V": <b64>, "W": <b64>}
+
+``chain_hash`` binds the ciphertext to one chain: a ciphertext encrypted
+under chain A's public key never decrypts under chain B's signatures, but
+silently ATTEMPTING it burns a pairing and yields a confusing FO-check
+error — so :func:`decrypt_with_beacon` rejects cross-chain envelopes up
+front when the caller supplies its chain info. ``v`` lets the envelope
+evolve; decrypting an envelope from a future scheme version fails closed.
 """
 
 from __future__ import annotations
@@ -16,12 +28,15 @@ from ..chain.info import Info
 from ..crypto import timelock
 from .interface import ClientError, Result
 
+SCHEME_VERSION = 1
+
 
 def encrypt_to_round(info: Info, round_no: int, plaintext: bytes) -> dict:
     """Encrypt so that the round's V2 signature decrypts
     (kyber/encrypt/timelock analogue, core/timelock_test.go:43-48)."""
     ct = timelock.encrypt(info.public_key, message_v2(round_no), plaintext)
     return {
+        "v": SCHEME_VERSION,
         "round": round_no,
         "chain_hash": info.hash().hex(),
         "U": ct.u.hex(),
@@ -30,18 +45,65 @@ def encrypt_to_round(info: Info, round_no: int, plaintext: bytes) -> dict:
     }
 
 
-def decrypt_with_beacon(ct: dict, result: Result) -> bytes:
-    """Decrypt once the round is out, using its unchained V2 signature."""
+def parse_envelope(ct: dict) -> timelock.Ciphertext:
+    """Envelope -> wire ciphertext, validating shape and scheme version
+    (shared by the client decrypt path and the serving vault). Raises
+    :class:`ClientError` on anything malformed."""
+    if not isinstance(ct, dict):
+        raise ClientError("timelock envelope must be a JSON object")
+    version = ct.get("v", 1)
+    if version != SCHEME_VERSION:
+        raise ClientError(
+            f"unsupported timelock scheme version {version!r} "
+            f"(this build speaks v{SCHEME_VERSION})")
+    if not isinstance(ct.get("round"), int) or ct["round"] < 1:
+        raise ClientError("timelock envelope needs an integer round >= 1")
+    try:
+        u = bytes.fromhex(ct["U"])
+        v = base64.b64decode(ct["V"], validate=True)
+        w = base64.b64decode(ct["W"], validate=True)
+    except (KeyError, TypeError, ValueError) as e:
+        raise ClientError(f"malformed timelock envelope: {e}")
+    if len(u) != 48:
+        raise ClientError("timelock envelope U must be 48 bytes of hex")
+    if len(v) != timelock.SIGMA_LEN:
+        raise ClientError(
+            f"timelock envelope V must be {timelock.SIGMA_LEN} bytes")
+    return timelock.Ciphertext(u=u, v=v, w=w)
+
+
+def check_chain(ct: dict, info: Info) -> None:
+    """Reject a ciphertext bound to a DIFFERENT chain than ``info``'s.
+    Envelopes always carry ``chain_hash`` (encrypt_to_round writes it);
+    an envelope without one predates this check and is let through."""
+    bound = ct.get("chain_hash")
+    if not bound:
+        return
+    if not isinstance(bound, str):
+        # the field arrives from unauthenticated POST bodies: a
+        # non-string must be a 4xx validation error, not an
+        # AttributeError 500 out of the handler
+        raise ClientError("timelock envelope chain_hash must be a "
+                          "hex string")
+    if bound.lower() != info.hash().hex():
+        raise ClientError(
+            f"cross-chain timelock ciphertext: bound to chain "
+            f"{bound[:16]}..., this chain is {info.hash().hex()[:16]}...")
+
+
+def decrypt_with_beacon(ct: dict, result: Result,
+                        info: Info | None = None) -> bytes:
+    """Decrypt once the round is out, using its unchained V2 signature.
+    Pass the chain ``info`` the beacon came from to reject cross-chain
+    ciphertexts up front (the envelope's ``chain_hash`` binding)."""
+    parsed = parse_envelope(ct)
+    if info is not None:
+        check_chain(ct, info)
     if result.round != ct["round"]:
         raise ClientError(
             f"need round {ct['round']}, got {result.round}")
     if not result.signature_v2:
         raise ClientError("beacon carries no V2 signature (pre-V2 era)")
-    parsed = timelock.Ciphertext(
-        u=bytes.fromhex(ct["U"]),
-        v=base64.b64decode(ct["V"]),
-        w=base64.b64decode(ct["W"]),
-    )
     return timelock.decrypt(result.signature_v2, parsed)
 
 

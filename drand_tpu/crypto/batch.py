@@ -483,6 +483,45 @@ def aggregate_round(pub_poly: PubPoly, msg: bytes, partials, t: int, n: int,
         return oks, sig
 
 
+def decrypt_round_batch(signature, cts) -> list[tuple[bool, bytes, str]]:
+    """Open ALL of a round's timelock ciphertexts against its V2
+    signature in one batched dispatch — the vault's round-boundary hot
+    call (drand_tpu/timelock). Returns ``(ok, plaintext, error)`` per
+    ciphertext, aligned with ``cts``, never raising per item.
+
+    Device tier: ONE batched GT dispatch (ops/engine.timelock_open —
+    the Miller line computation over the shared signature runs once, K
+    varying U points on the batch axis) under
+    ``engine_op_seconds{op="timelock", path="device"}``; a KAT-gate
+    failure falls back to the host tier with a fallback-ledger entry.
+    Host tier: the shared-signature batch decryptor
+    (crypto/timelock.decrypt_batch) under ``path="host_shared"`` — the
+    per-round line precomputation is hoisted, outcomes bit-identical to
+    a per-item ``timelock.decrypt`` loop. The Fujisaki-Okamoto check is
+    host-exact on BOTH tiers."""
+    from . import timelock
+
+    n = len(cts)
+    if n and _use_device(n):
+        try:
+            _note_dispatch("timelock")
+            with _timed("timelock", "device", n):
+                out = engine().timelock_open(signature, cts)
+            if out is not None:
+                _note_device_ok()
+                return out
+            _ledger_note(
+                "timelock", "device",
+                "no timelock bucket passed known-answer validation — "
+                "host shared-signature decrypt decides")
+        except Exception as e:  # noqa: BLE001 — host path is the oracle
+            if _MODE == "device":
+                raise
+            _note_fallback("timelock", e)
+    with _timed("timelock", "host_shared", n):
+        return timelock.decrypt_batch(signature, cts)
+
+
 def eval_commits(polys: list[PubPoly], index: int) -> list[PointG1]:
     """Evaluate many commitment polynomials at one index — the DKG deal
     share-check `g·s_d == Σ_k C_{d,k}·index^k` done for every dealer at

@@ -46,7 +46,8 @@ class PublicServer:
                  logger: KVLogger | None = None,
                  watch_timeout: float = 30.0,
                  peer_metrics_fn=None,
-                 enable_pprof: bool = False):
+                 enable_pprof: bool = False,
+                 timelock_service=None):
         self._client = client
         self._clock = clock or SystemClock()
         self._l = logger or default_logger("http")
@@ -54,6 +55,10 @@ class PublicServer:
         # optional async addr -> bytes hook relaying a group member's
         # metrics over the node transport (metrics.go:266 GroupHandler)
         self._peer_metrics_fn = peer_metrics_fn
+        # optional timelock vault front (drand_tpu/timelock): adds the
+        # submit/status routes and opens pending ciphertexts from the
+        # watch loop's round boundary (covers relays with no store hook)
+        self._timelock = timelock_service
         self._latest: Result | None = None
         self._next_round_event = asyncio.Event()
         self._watch_task: asyncio.Task | None = None
@@ -69,6 +74,11 @@ class PublicServer:
             web.get("/metrics", self._handle_metrics),
             web.get("/peer/{addr}/metrics", self._handle_peer_metrics),
         ])
+        if timelock_service is not None:
+            self.app.add_routes([
+                web.post("/timelock", self._handle_timelock_submit),
+                web.get("/timelock/{id}", self._handle_timelock_status),
+            ])
         # the round-timeline surface is on by default (no profiling
         # cost; group topology is already public via /info and the
         # group file) but operators can opt out with
@@ -88,6 +98,8 @@ class PublicServer:
     # ------------------------------------------------------------ serving
     async def start(self, host: str, port: int) -> web.TCPSite:
         self._watch_task = asyncio.ensure_future(self._watch_loop())
+        if self._timelock is not None:
+            await self._timelock.start()
         runner = web.AppRunner(self.app)
         await runner.setup()
         site = web.TCPSite(runner, host, port)
@@ -98,7 +110,12 @@ class PublicServer:
     async def stop(self) -> None:
         if self._watch_task is not None:
             self._watch_task.cancel()
+        # stop accepting requests BEFORE closing the vault: an in-flight
+        # submit against a closed sqlite handle would 500 instead of
+        # being refused cleanly
         await self._runner.cleanup()
+        if self._timelock is not None:
+            await self._timelock.close()
 
     async def _watch_loop(self) -> None:
         """Track the tip so /public/{next} can long-poll (server.go:102)."""
@@ -108,6 +125,10 @@ class PublicServer:
                     self._latest = r
                     self._next_round_event.set()
                     self._next_round_event = asyncio.Event()
+                    if self._timelock is not None:
+                        # round boundary: open the round's pending
+                        # timelock ciphertexts (one batched dispatch)
+                        self._timelock.on_result(r)
             except asyncio.CancelledError:
                 return
             except Exception as e:  # noqa: BLE001 — keep serving
@@ -269,6 +290,55 @@ class PublicServer:
         snap["status"] = "ok" if ok else "lagging"
         snap["max_lag"] = READY_MAX_LAG
         return web.json_response(snap, status=200 if ok else 503)
+
+    # ------------------------------------------------------------ timelock
+    async def _handle_timelock_submit(self, request: web.Request
+                                      ) -> web.Response:
+        """POST /timelock: accept a ciphertext locked to a future round
+        into the vault. Body = the client envelope JSON
+        (client/timelock.encrypt_to_round). 202 with the status record;
+        400 on validation failure, 503 while the chain is unknown."""
+        from ..timelock.service import TimelockError
+
+        try:
+            envelope = await request.json()
+        except (ValueError, UnicodeDecodeError):
+            return web.json_response({"error": "body is not JSON"},
+                                     status=400)
+        try:
+            rec = await self._timelock.submit(envelope)
+        except TimelockError as e:
+            msg = str(e)
+            status = 503 if "chain info unavailable" in msg else 400
+            return web.json_response({"error": msg}, status=status)
+        return web.json_response(rec, status=202)
+
+    async def _handle_timelock_status(self, request: web.Request
+                                      ) -> web.Response:
+        """GET /timelock/{id}: the ciphertext's status record. Opened
+        and rejected records are IMMUTABLE — served with an ETag and
+        Cache-Control: immutable so a CDN can absorb result polling the
+        same way it absorbs /public/{round}; pending records are
+        no-store (they change at the round boundary)."""
+        token = request.match_info["id"]
+        rec = await self._timelock.status(token)
+        if rec is None:
+            return web.json_response({"error": "unknown ciphertext id"},
+                                     status=404)
+        if rec["status"] == "pending":
+            resp = web.json_response(rec)
+            resp.headers["Cache-Control"] = "no-store"
+            return resp
+        etag = f'"tl-{rec["id"]}-{rec["status"]}"'
+        if request.headers.get("If-None-Match") == etag:
+            return web.Response(status=304, headers={
+                "ETag": etag,
+                "Cache-Control": "public, max-age=31536000, immutable"})
+        resp = web.json_response(rec)
+        resp.headers["ETag"] = etag
+        resp.headers["Cache-Control"] = \
+            "public, max-age=31536000, immutable"
+        return resp
 
     async def _handle_readyz(self, request: web.Request) -> web.Response:
         """Readiness: chain info servable (the DKG-complete signal at

@@ -39,6 +39,14 @@ Additions beyond the reference (the TPU engine + round tracing):
       catch-up span with no host hashing)
   hash_to_g2_cache_requests{result}    [private] hash-to-G2 memo
       hit/miss counters (crypto/hash_to_curve.py per-round keyed LRU)
+Timelock serving tier (drand_tpu/timelock, ISSUE 9):
+  timelock_gt_cache_requests{result}   [private] encrypt-side per-round
+      e(pub, H2(round)) base memo hit/miss (crypto/timelock.py)
+  timelock_pending_ciphertexts         [private] vault backlog waiting
+      for a future round's V2 signature
+  timelock_ciphertexts_total{result}   [private] vault lifecycle counter
+      (submitted | opened | rejected); round-open latency rides
+      engine_op_seconds{op="timelock", path=device|host_shared}
 Chain-health / SLO set (obs/health.py, ISSUE 6 — fed by the
 DiscrepancyStore on every stored beacon and re-evaluated by /healthz):
   beacon_round_lateness_seconds        [group]   actual emit time vs the
@@ -153,6 +161,23 @@ H2C_CACHE_REQUESTS = Counter(
     "hash_to_g2_cache_requests",
     "hash_to_g2 memo lookups by result (hit|miss) — the per-round "
     "hash-to-curve LRU in crypto/hash_to_curve.py",
+    ["result"], registry=REGISTRY)
+
+# ---- timelock serving tier (drand_tpu/timelock, ISSUE 9) ------------------
+TIMELOCK_GT_CACHE_REQUESTS = Counter(
+    "timelock_gt_cache_requests",
+    "timelock encrypt GT-base memo lookups by result (hit|miss) — the "
+    "per-round e(pub, H2(round)) LRU in crypto/timelock.py",
+    ["result"], registry=REGISTRY)
+TIMELOCK_PENDING = Gauge(
+    "timelock_pending_ciphertexts",
+    "Ciphertexts in the timelock vault still waiting for their round's "
+    "V2 signature", registry=REGISTRY)
+TIMELOCK_CIPHERTEXTS = Counter(
+    "timelock_ciphertexts_total",
+    "Timelock vault ciphertext lifecycle events by result (submitted = "
+    "accepted into the vault; opened = decrypted at the round boundary; "
+    "rejected = failed the Fujisaki-Okamoto check or could never open)",
     ["result"], registry=REGISTRY)
 
 # ---- round tracing (obs/trace.py) -----------------------------------------

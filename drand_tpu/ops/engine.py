@@ -97,6 +97,17 @@ def _meter_rows(n: int) -> None:
     N_MILLER_PAIRS += 2 * n
 
 
+def _meter_gt_rows(n: int) -> None:
+    """One batched GT (pairing-value) dispatch of n single-pair rows —
+    the timelock round-open graph (one Miller pair per lane, not the
+    verify tiers' two). Counted once per public dispatch like
+    _meter_rows, so tests can prove "K ciphertexts opened in ONE
+    dispatch" from the same meters."""
+    global N_PRODUCT_CHECKS, N_MILLER_PAIRS
+    N_PRODUCT_CHECKS += 1
+    N_MILLER_PAIRS += n
+
+
 def _drain(launches) -> np.ndarray:
     """Collect per-bucket outputs with ONE device-side stack and ONE
     host transfer. Through the remote transport, every d2h transfer —
@@ -248,6 +259,12 @@ class BatchedEngine:
         self._wire_rlc_ok: dict[int, bool] = {}
         self._wire_rlc_jit = jax.jit(self._wire_rlc_graph)
         self._wire_rlc_sharded_ok: dict[int, bool] = {}
+        # timelock round-open: batched canonical-GT pairings against ONE
+        # shared (pre-folded) G2 point — the round's V2 signature; the K
+        # varying U points ride the batch axis (crypto/timelock.py
+        # documents the shared-signature structure and the 3^-1 fold)
+        self._tl_ok: dict[int, bool] = {}
+        self._tl_jit = jax.jit(self._tl_graph)
         # GLS ψ² 4-D scalar split for the recovery/aggregation MSMs:
         # 255-bit Lagrange scalars become four <= 64-bit digit lanes on
         # (P, -ψP, ψ²P, -ψ³P) (crypto/endo.py), quartering the device
@@ -400,6 +417,8 @@ class BatchedEngine:
                         in sorted(self._rlc_ok.items())},
                 "wire_rlc": {str(b): ok for b, ok
                              in sorted(self._wire_rlc_ok.items())},
+                "timelock": {str(b): ok for b, ok
+                             in sorted(self._tl_ok.items())},
                 # shard-shape key: bucket over mesh lanes per shard
                 "wire_rlc_sharded": {
                     f"b{b}/m{self._mesh_size}": ok for b, ok
@@ -1209,6 +1228,129 @@ class BatchedEngine:
         if flat is None:
             return None
         return np.array([bool(flat[s:s + c].all()) for s, c in spans])
+
+    # ------------------------------------------------- timelock tier
+    # Batched IBE decryption for the timelock vault's round-boundary
+    # open (crypto/timelock.py): all K ciphertexts of a round share ONE
+    # G2 point — the round's V2 signature — so the device graph runs the
+    # Miller-loop line/T computation over `sig` ONCE (no batch axis) and
+    # only the K varying U_i in G1 ride the batch axis, exactly like the
+    # verify tiers. The graph outputs the canonical GT value per lane
+    # (the 3^-1 cube correction is pre-folded into the shared point on
+    # host — one G2 scalar mul per round); the Fujisaki-Okamoto
+    # re-encryption check stays host-exact per item, so a wrong device
+    # GT can only FALSE-REJECT (the host path then decides) — the same
+    # soundness posture as every combine tier.
+
+    @staticmethod
+    def _tl_graph(xp, yp, qx, qy):
+        """Canonical-GT pairings of a batch of G1 points against one
+        shared G2 point: xp/yp (b, NLIMBS) affine mont G1 coords,
+        qx/qy (2, NLIMBS) affine mont Fp2 coords of the PRE-FOLDED
+        signature. Returns (b, 2, 3, 2, NLIMBS) Fp12 lanes."""
+        q_aff = jnp.stack([qx, qy], axis=-3)[None, None]
+        f = pairing.miller_loop_shared_q((xp[:, None], yp[:, None]), q_aff)
+        return pairing.final_exponentiation(f, canonical=False)
+
+    def _launch_tl_bucket(self, us, q_np, b: int):
+        """Dispatch one padded GT bucket (pad lanes = generator, sliced
+        away); returns (device_out, count) without synchronizing."""
+        gen = _g1_aff(PointG1.generator())
+        xs = np.broadcast_to(gen[0], (b, limb.NLIMBS)).copy()
+        ys = np.broadcast_to(gen[1], (b, limb.NLIMBS)).copy()
+        for i, xy in enumerate(PointG1.batch_to_affine(us)):
+            aff = _g1_xy(xy)
+            xs[i], ys[i] = aff[0], aff[1]
+        out = self._tl_jit(jnp.asarray(xs), jnp.asarray(ys),
+                           jnp.asarray(q_np[0]), jnp.asarray(q_np[1]))
+        return out, len(us)
+
+    def _run_tl_bucket(self, us, q_np, b: int) -> list:
+        """One synced bucket as host Fp12 lanes INCLUDING pads (the KAT
+        probe checks every lane)."""
+        from . import tower
+
+        dev, _ = self._launch_tl_bucket(us, q_np, b)
+        host = np.asarray(dev)
+        return [tower.fp12_from_device(host[i]) for i in range(b)]
+
+    def _check_tl_bucket(self, b: int) -> bool:
+        """KAT the GT graph per bucket against the host shared-signature
+        decryptor on fixed points — full-lane (pad rows must reproduce
+        the generator pairing; the axon failure mode is lane-dependent
+        silent miscompiles). A failure disables the bucket; decryption
+        soundness never depended on it (host-exact FO check)."""
+        ok = self._tl_ok.get(b)
+        if ok is not None:
+            return ok
+        from ..crypto import timelock as tl
+
+        sig = hash_to_g2(b"engine-timelock-kat").mul(0x5A17)
+        rd = tl.RoundDecryptor(sig)
+        g1 = PointG1.generator()
+        us = [g1.mul(2), g1.mul(3)][:b]
+        try:
+            got = self._run_tl_bucket(us, _g2_aff(rd.sig_folded), b)
+            expect = [rd.gt(u) for u in us]
+            pad_expect = rd.gt(g1)
+            ok = (all(g == e for g, e in zip(got, expect))
+                  and all(g == pad_expect for g in got[len(us):]))
+        except Exception:  # noqa: BLE001 — trace/lowering failures too
+            ok = False
+        self._tl_ok[b] = ok
+        if not ok:
+            from ..utils.logging import default_logger
+
+            default_logger("engine").warn(
+                "engine", "timelock_bucket_disabled", bucket=b)
+        return ok
+
+    def timelock_open(self, signature, cts) -> list | None:
+        """Open a round's timelock ciphertexts with ONE batched GT
+        dispatch: per-item ``(ok, plaintext, error)`` outcomes, or None
+        when no bucket passed known-answer validation (the dispatcher
+        falls back to the host shared-signature tier). Decode failures
+        and infinity U points are per-item host decisions and never
+        enter the batch; the FO accept/reject runs host-exact on every
+        item (crypto/timelock._finish), with device-rejected items
+        re-decided by the host pairing — false-reject-only."""
+        from ..crypto import timelock as tl
+        from . import tower
+
+        n = len(cts)
+        if n == 0:
+            return []
+        rd = tl.RoundDecryptor(signature)
+        us: list[PointG1 | None] = []
+        for ct in cts:
+            try:
+                u = PointG1.from_bytes(ct.u, subgroup_check=False)
+                us.append(None if u.is_infinity() else u)
+            except ValueError:
+                us.append(None)
+        live = [u for u in us if u is not None]
+        if not live:
+            return rd.decrypt_many(cts)
+        b = self._good_bucket(len(live), check=self._check_tl_bucket)
+        if b is None:
+            return None
+        _meter_gt_rows(len(live))
+        q_np = _g2_aff(rd.sig_folded)
+        launches = [self._launch_tl_bucket(live[i:i + b], q_np, b)
+                    for i in range(0, len(live), b)]
+        # one device-side concat + one host transfer (see _drain)
+        if len(launches) == 1:
+            host = np.asarray(launches[0][0])
+        else:
+            host = np.asarray(jnp.concatenate([d for d, _ in launches]))
+        flat = []
+        for j, (_, cnt) in enumerate(launches):
+            rows = host[j * b:j * b + cnt]
+            flat.extend(tower.fp12_from_device(rows[i])
+                        for i in range(cnt))
+        it = iter(flat)
+        gts = [None if u is None else next(it) for u in us]
+        return rd.decrypt_many(cts, gts=gts)
 
     def verify_sigs(self, pubkey: PointG1, pairs,
                     dst: bytes = DEFAULT_DST_G2) -> list[bool]:

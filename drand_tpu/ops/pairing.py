@@ -213,6 +213,48 @@ def miller_loop(p_affs, q_affs):
     return f12_conj(f)  # x < 0
 
 
+def miller_loop_shared_q(p_affs, q_aff):
+    """Batched Miller loop against ONE shared G2 point — the timelock
+    round-open structure (crypto/timelock.py: every ciphertext of a round
+    pairs its own U in G1 with the round's V2 signature).
+
+    The G2-side line/T trajectory carries NO batch axis: the doubling and
+    addition steps run once per Miller step, exactly like a single-pair
+    loop, and only the line evaluations (the xp/yp scalings of the c0/c5
+    coefficients) and the per-item Fp12 accumulation ride the batch axis.
+    Same cond-free scan segmentation as :func:`miller_loop`.
+
+    p_affs: tuple (xp, yp) arrays shaped (b, 1, 32), mont domain.
+    q_aff: (1, 1, 2, 2, 32) affine twist point, mont domain — must not be
+    at infinity (callers filter).
+    Returns f (b, 2, 3, 2, 32); the |x|<0 conjugation is applied.
+    """
+    xp, yp = p_affs
+    xq, yq = q_aff[..., 0, :, :], q_aff[..., 1, :, :]
+    T = (xq, yq, tower.f2_one(xq.shape[:-2]) + xq * 0)
+    # f's tag comes from the BATCHED side so the scan carry holds the
+    # (b, ...) accumulator from step one (the shared-T coefficients
+    # broadcast into it)
+    tag = xp[..., 0, 0][..., None, None, None, None] * 0
+    f = f12_one() + tag
+
+    def dbl_body(state, _):
+        f, T = state
+        f = f12_sqr(f)
+        T, (c0, c3, c5) = _dbl_step(T, p_affs)
+        c3 = jnp.broadcast_to(c3, c0.shape)
+        f = _sparse_mul_035(f, c0, c3, c5, 1)
+        return (f, T), None
+
+    for seg_len, has_add in zip(_MILLER_SEGMENTS, _MILLER_ADDS):
+        (f, T), _ = jax.lax.scan(dbl_body, (f, T), None, length=seg_len)
+        if has_add:
+            T, (c0, c3, c5) = _add_step(T, q_aff, p_affs)
+            c3 = jnp.broadcast_to(c3, c0.shape)
+            f = _sparse_mul_035(f, c0, c3, c5, 1)
+    return f12_conj(f)  # x < 0
+
+
 # ---------------------------------------------------------------------------
 # Final exponentiation (mirrors crypto/pairing.py final_exponentiation).
 #

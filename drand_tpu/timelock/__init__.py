@@ -1,0 +1,27 @@
+"""Timelock serving tier (ISSUE 9): a round-boundary decryption vault.
+
+Clients POST ciphertexts locked to future rounds (the tlock "encrypt to
+the future" scheme over unchained V2 signatures — crypto/timelock.py,
+client/timelock.py); the vault persists them keyed by round and, when the
+chain reaches a round, opens EVERY pending ciphertext for it in one
+batched dispatch (crypto/batch.decrypt_round_batch: device GT graph with
+the round signature's Miller lines computed once, host shared-signature
+tier otherwise).
+
+- :class:`TimelockVault` (vault.py): the persistent store — the
+  chain/store.py single-writer SQLite pattern, surviving daemon restart.
+- :class:`TimelockService` (service.py): submit validation, the
+  round-boundary open (hooked off the DiscrepancyStore
+  ``note_round_complete`` path AND the PublicServer watch loop, so both
+  daemons and relays open at the boundary), and the catch-up sweep that
+  opens rounds missed while the process was down.
+- HTTP surface: ``POST /timelock`` + ``GET /timelock/{id}`` on
+  ``PublicServer`` (http_server/server.py) — opened results are
+  immutable and served with an ETag.
+"""
+
+from .vault import TimelockVault, VaultError
+from .service import TimelockService, TimelockError, note_round_complete
+
+__all__ = ["TimelockVault", "VaultError", "TimelockService",
+           "TimelockError", "note_round_complete"]

@@ -1,0 +1,350 @@
+"""The timelock serving logic: submit validation + round-boundary opens.
+
+One :class:`TimelockService` fronts a :class:`~.vault.TimelockVault` and
+a chain client. Submissions are validated against the chain (scheme
+version, envelope shape, the cross-chain ``chain_hash`` binding, size
+caps) and persisted pending; when the chain reaches a round, EVERY
+pending ciphertext for it opens in one ``crypto/batch.decrypt_round_batch``
+dispatch (device GT graph or host shared-signature tier — both hoist the
+round signature's Miller work out of the per-item loop).
+
+Round boundaries arrive two ways, both funnelling into the same
+idempotent sweep:
+
+- the daemon's store path: ``DiscrepancyStore.put`` calls this module's
+  :func:`note_round_complete` next to the OTLP exporter's (the "existing
+  note_round_complete path" — ISSUE 9), thread-safe because aggregation
+  runs in ``asyncio.to_thread`` workers;
+- the PublicServer watch loop (:meth:`TimelockService.on_result`), which
+  also covers relays that have no local store.
+
+A catch-up sweep at service start (and on every boundary) opens rounds
+that passed while the process was down — vault state survives restarts.
+
+Event-loop discipline (tools/analyze loopblock): every vault/sqlite call
+from async code goes through ``asyncio.to_thread``; the batched decrypt
+(pairing-class) likewise; fire-and-forget opens go through
+``drand_tpu.utils.aio.spawn``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import os
+import weakref
+
+from ..chain.beacon import Beacon
+from ..client import timelock as client_timelock
+from ..client.interface import Client, ClientError, Result, \
+    result_from_beacon
+from ..crypto import batch
+from ..utils.aio import spawn
+from ..utils.logging import KVLogger, default_logger
+from .vault import TimelockVault
+
+# submission caps: W (the masked payload) and the global pending backlog
+MAX_PLAINTEXT = int(os.environ.get("DRAND_TPU_TIMELOCK_MAX_BYTES",
+                                   str(64 * 1024)))
+MAX_PENDING = int(os.environ.get("DRAND_TPU_TIMELOCK_MAX_PENDING",
+                                 str(100_000)))
+
+
+class TimelockError(Exception):
+    """Submission/validation failure (HTTP layer maps it to 4xx)."""
+
+
+def canonical_envelope(envelope: dict, parsed) -> dict:
+    """The envelope re-encoded from its PARSED values — what the vault
+    stores and the token hashes. Tokenizing the client's strings would
+    let one ciphertext mint unlimited distinct vault rows (junk keys,
+    hex case, non-canonical base64 trailing bits, omitted-vs-explicit
+    version, bool-typed round) — re-encoding collapses every malleable
+    representation of the same ciphertext to one row."""
+    import base64
+
+    canon = {
+        "v": client_timelock.SCHEME_VERSION,
+        "round": int(envelope["round"]),
+        "U": parsed.u.hex(),
+        "V": base64.b64encode(parsed.v).decode(),
+        "W": base64.b64encode(parsed.w).decode(),
+    }
+    bound = envelope.get("chain_hash")
+    if bound:
+        canon["chain_hash"] = bound.lower()
+    return canon
+
+
+def _token_of_canonical(canon: dict) -> str:
+    return hashlib.blake2b(client_timelock.dumps(canon).encode(),
+                           digest_size=16).hexdigest()
+
+
+def envelope_token(envelope: dict) -> str:
+    """Deterministic ciphertext id: the blake2b of the canonical
+    (parsed-value) envelope JSON — a client retrying a submit gets the
+    same id back instead of a duplicate vault row, in ANY equivalent
+    encoding of the same ciphertext."""
+    parsed = client_timelock.parse_envelope(envelope)
+    return _token_of_canonical(canonical_envelope(envelope, parsed))
+
+
+class TimelockService:
+    def __init__(self, vault: TimelockVault, client: Client,
+                 logger: KVLogger | None = None):
+        self._vault = vault
+        self._client = client
+        self._l = logger or default_logger("timelock")
+        self._info = None
+        self._opening: set[int] = set()
+        self._head = 0  # last chain head this service has seen
+        self._tasks: set[asyncio.Future] = set()  # in-flight sweeps
+        self._loop: asyncio.AbstractEventLoop | None = None
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> None:
+        """Bind to the running loop and kick the catch-up sweep for
+        rounds that completed while the process was down (restart
+        persistence). The sweep is SPAWNED, not awaited: a large missed
+        backlog must not hold the HTTP port unbound (PublicServer.start
+        awaits this) while orchestrators probe a dead /healthz."""
+        self._loop = asyncio.get_running_loop()
+        register(self)
+        from .. import metrics
+
+        metrics.TIMELOCK_PENDING.set(
+            await asyncio.to_thread(self._vault.pending_count))
+        self._spawn_sweep(name="timelock-catchup")
+
+    async def close(self) -> None:
+        """Unhook, cancel in-flight sweeps, release the vault's sqlite
+        handle (a daemon restart must not leak WAL connections)."""
+        unregister(self)
+        for task in list(self._tasks):
+            task.cancel()
+        if self._tasks:
+            await asyncio.gather(*self._tasks, return_exceptions=True)
+        await asyncio.to_thread(self._vault.close)
+
+    def _spawn_sweep(self, result: Result | None = None,
+                     name: str = "timelock-sweep") -> None:
+        task = spawn(self._sweep(result), name=name)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def info(self):
+        if self._info is None:
+            self._info = await self._client.info()
+        return self._info
+
+    # ------------------------------------------------------------ submit
+    async def submit(self, envelope: dict) -> dict:
+        """Validate + persist one ciphertext; returns the status record.
+        Raises :class:`TimelockError` on rejection."""
+        try:
+            parsed = client_timelock.parse_envelope(envelope)
+        except ClientError as e:
+            raise TimelockError(str(e))
+        if len(parsed.w) > MAX_PLAINTEXT:
+            raise TimelockError(
+                f"payload too large: {len(parsed.w)} > {MAX_PLAINTEXT} "
+                f"bytes (DRAND_TPU_TIMELOCK_MAX_BYTES)")
+        try:
+            info = await self.info()
+        except ClientError as e:
+            raise TimelockError(f"chain info unavailable: {e}")
+        try:
+            client_timelock.check_chain(envelope, info)
+        except ClientError as e:
+            raise TimelockError(str(e))
+        envelope = canonical_envelope(envelope, parsed)
+        token = _token_of_canonical(envelope)
+        # idempotent-retry lookup BEFORE the backlog cap: a client
+        # retrying an already-accepted submission must get its status
+        # back even when the vault is full (retries cluster under load)
+        if await asyncio.to_thread(self._vault.get, token) is not None:
+            return await self.status(token)
+        pending = await asyncio.to_thread(self._vault.pending_count)
+        if pending >= MAX_PENDING:
+            raise TimelockError(
+                f"vault backlog full ({pending} pending ciphertexts)")
+        fresh = await asyncio.to_thread(
+            self._vault.submit, token, envelope["round"], envelope)
+        from .. import metrics
+
+        if fresh:
+            metrics.TIMELOCK_CIPHERTEXTS.labels(result="submitted").inc()
+            metrics.TIMELOCK_PENDING.set(pending + 1)
+            self._l.info("timelock", "submitted", id=token,
+                         round=envelope["round"])
+            # the round may already be on chain (locked to the past, or
+            # submitted in the boundary race) — sweep opportunistically,
+            # but not for rounds beyond the last-seen head: the common
+            # future-round submit must not cost a head fetch per POST
+            # (head 0 = no boundary seen yet; the sweep resolves it)
+            if self._head == 0 or envelope["round"] <= self._head:
+                self._spawn_sweep(name=f"timelock-sweep-{token[:8]}")
+        return await self.status(token)
+
+    async def status(self, token: str) -> dict | None:
+        """The public status record for one ciphertext id (None =
+        unknown id)."""
+        rec = await asyncio.to_thread(self._vault.get, token)
+        if rec is None:
+            return None
+        out = {"id": rec["id"], "round": rec["round"],
+               "status": rec["status"], "submitted": rec["submitted"]}
+        if rec["status"] == "opened":
+            import base64
+
+            out["plaintext"] = base64.b64encode(rec["plaintext"]).decode()
+            out["opened"] = rec["opened"]
+        elif rec["status"] == "rejected":
+            out["error"] = rec["error"]
+            out["opened"] = rec["opened"]
+        return out
+
+    # ------------------------------------------------- round boundaries
+    def on_result(self, r: Result) -> None:
+        """PublicServer watch-loop hook (loop thread): a new beacon
+        landed — open everything due, carrying the fresh signature so
+        the common case needs no extra fetch."""
+        self._spawn_sweep(r, name=f"timelock-open-{r.round}")
+
+    def note_beacon(self, b: Beacon) -> None:
+        """DiscrepancyStore hook — may fire from a to_thread aggregation
+        worker, so hop onto the service loop before spawning."""
+        if b.round == 0:
+            return
+        r = result_from_beacon(b)
+        loop = self._loop
+        if loop is None or loop.is_closed():
+            return
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop:
+            self.on_result(r)
+        else:
+            loop.call_soon_threadsafe(self.on_result, r)
+
+    async def _sweep(self, result: Result | None = None) -> None:
+        """Open every pending round the chain has reached. Idempotent
+        and double-dispatch-guarded: the store hook, the watch hook and
+        the start-up catch-up can all fire for the same round."""
+        head = result.round if result is not None else 0
+        if head == 0:
+            try:
+                head = (await self._client.get(0)).round
+            except ClientError:
+                return  # no chain yet; the next boundary retries
+        self._head = max(self._head, head)
+        rounds = await asyncio.to_thread(self._vault.pending_rounds, head)
+        for rd in rounds:
+            if rd in self._opening:
+                continue
+            self._opening.add(rd)
+            try:
+                if result is not None and result.round == rd:
+                    r = result
+                else:
+                    try:
+                        r = await self._client.get(rd)
+                    except ClientError as e:
+                        self._l.warn("timelock", "round_fetch_failed",
+                                     round=rd, err=str(e))
+                        continue
+                await self._open_round(rd, r)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — isolate per round
+                # one bad round (garbage signature from an --insecure
+                # upstream, an unparseable stored envelope) must not
+                # wedge the ascending sweep and starve every LATER
+                # round forever; the round stays pending and retries
+                # at the next boundary
+                self._l.warn("timelock", "round_open_failed", round=rd,
+                             err=f"{type(e).__name__}: {e}")
+            finally:
+                self._opening.discard(rd)
+
+    async def _open_round(self, round_no: int, r: Result) -> None:
+        """ONE batched dispatch opens the round's pending set."""
+        items = await asyncio.to_thread(
+            self._vault.pending_for_round, round_no)
+        if not items:
+            return
+        from .. import metrics
+
+        if not r.signature_v2:
+            # no V2 signature: pre-V2 era round — OR a source that
+            # simply omitted the field (a relay upstream serving the
+            # legacy JSON shape). Opened/rejected rows are immutable,
+            # so a terminal reject here would permanently burn
+            # ciphertexts another source could still open: keep them
+            # pending (one fetch per boundary sweep, bounded) and warn.
+            self._l.warn("timelock", "round_without_v2_signature",
+                         round=round_no, pending=len(items))
+            return
+        cts, good = [], []
+        for token, env in items:
+            try:
+                cts.append(client_timelock.parse_envelope(env))
+                good.append(token)
+            except ClientError as e:
+                # a stored envelope THIS build can't parse (vault file
+                # shared across versions): leave it pending for a build
+                # that can, never let it abort the round's open
+                self._l.warn("timelock", "stored_envelope_unparseable",
+                             id=token, err=str(e))
+        if not cts:
+            return
+        outcomes = await asyncio.to_thread(
+            batch.decrypt_round_batch, r.signature_v2, cts)
+        # ONE vault transaction for the whole round (a 10k-ciphertext
+        # round must not pay 10k thread hops + 10k commits after a
+        # single batched decrypt)
+        results = [(token, ok, plaintext, err)
+                   for token, (ok, plaintext, err)
+                   in zip(good, outcomes)]
+        opened, rejected = await asyncio.to_thread(
+            self._vault.finish_round, results)
+        if opened:
+            metrics.TIMELOCK_CIPHERTEXTS.labels(result="opened").inc(opened)
+        if rejected:
+            metrics.TIMELOCK_CIPHERTEXTS.labels(
+                result="rejected").inc(rejected)
+        metrics.TIMELOCK_PENDING.set(
+            await asyncio.to_thread(self._vault.pending_count))
+        self._l.info("timelock", "round_opened", round=round_no,
+                     opened=opened, rejected=rejected)
+
+
+# ---------------------------------------------------------------------------
+# The DiscrepancyStore hook (the "existing note_round_complete path"):
+# chain/store.py calls note_round_complete(beacon) for every stored
+# beacon, next to the OTLP exporter's flush. A weak registry keeps the
+# store layer decoupled from service lifetime — no service, no work.
+# ---------------------------------------------------------------------------
+
+_ACTIVE: "weakref.ref[TimelockService] | None" = None
+
+
+def register(svc: TimelockService) -> None:
+    global _ACTIVE
+    _ACTIVE = weakref.ref(svc)
+
+
+def unregister(svc: TimelockService) -> None:
+    global _ACTIVE
+    if _ACTIVE is not None and _ACTIVE() is svc:
+        _ACTIVE = None
+
+
+def note_round_complete(b: Beacon) -> None:
+    """Store-path boundary hook (chain/store.DiscrepancyStore.put)."""
+    svc = _ACTIVE() if _ACTIVE is not None else None
+    if svc is not None:
+        svc.note_beacon(b)

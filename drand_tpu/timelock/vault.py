@@ -1,0 +1,170 @@
+"""The timelock vault: ciphertexts keyed by their unlock round.
+
+Same storage discipline as the chain store (chain/store.py SQLiteStore):
+single-writer append-mostly workload, stdlib sqlite3 with WAL, every
+statement under one lock, ``check_same_thread=False`` because callers
+reach it through ``asyncio.to_thread`` workers. State survives daemon
+restart — a pending ciphertext submitted before a crash opens at the
+next boundary sweep (service.py).
+
+Rows are immutable once opened/rejected (the HTTP layer serves them with
+an ETag and ``Cache-Control: immutable``): ``set_opened``/``set_rejected``
+only ever transition ``pending`` rows.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+
+
+class VaultError(Exception):
+    pass
+
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS timelock (
+  id        TEXT PRIMARY KEY,
+  round     INTEGER NOT NULL,
+  envelope  TEXT NOT NULL,
+  status    TEXT NOT NULL DEFAULT 'pending',
+  plaintext BLOB,
+  error     TEXT,
+  submitted REAL NOT NULL,
+  opened    REAL
+);
+CREATE INDEX IF NOT EXISTS timelock_round ON timelock (round, status);
+-- pending_count() runs on EVERY submit (the backlog cap) and after
+-- every round open (the gauge): a partial index keeps it O(pending)
+-- instead of scanning a lifetime of opened/rejected rows
+CREATE INDEX IF NOT EXISTS timelock_pending ON timelock (status)
+  WHERE status = 'pending';
+"""
+
+
+class TimelockVault:
+    """Persistent round-keyed ciphertext store (``:memory:`` for tests)."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.executescript(_SCHEMA)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA synchronous=NORMAL")
+        self._conn.commit()
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            (n,) = self._conn.execute(
+                "SELECT COUNT(*) FROM timelock").fetchone()
+        return n
+
+    def submit(self, token: str, round_no: int, envelope: dict) -> bool:
+        """Insert a pending ciphertext; False when the token already
+        exists (idempotent resubmission — the token is derived from the
+        envelope content, so a retry is a no-op, not a duplicate)."""
+        with self._lock:
+            cur = self._conn.execute(
+                "INSERT OR IGNORE INTO timelock"
+                " (id, round, envelope, status, submitted)"
+                " VALUES (?, ?, ?, 'pending', ?)",
+                (token, round_no, json.dumps(envelope, sort_keys=True),
+                 time.time()))
+            self._conn.commit()
+            return cur.rowcount == 1
+
+    def get(self, token: str) -> dict | None:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT id, round, envelope, status, plaintext, error,"
+                " submitted, opened FROM timelock WHERE id = ?",
+                (token,)).fetchone()
+        if row is None:
+            return None
+        return {
+            "id": row[0], "round": row[1],
+            "envelope": json.loads(row[2]), "status": row[3],
+            "plaintext": row[4], "error": row[5],
+            "submitted": row[6], "opened": row[7],
+        }
+
+    def pending_rounds(self, up_to: int | None = None) -> list[int]:
+        """Distinct rounds with pending ciphertexts, ascending; bounded
+        by ``up_to`` (the chain head) when given."""
+        q = ("SELECT DISTINCT round FROM timelock WHERE status = 'pending'")
+        args: tuple = ()
+        if up_to is not None:
+            q += " AND round <= ?"
+            args = (up_to,)
+        with self._lock:
+            rows = self._conn.execute(q + " ORDER BY round", args).fetchall()
+        return [r[0] for r in rows]
+
+    def pending_for_round(self, round_no: int) -> list[tuple[str, dict]]:
+        """(token, envelope) of every pending ciphertext for a round."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT id, envelope FROM timelock"
+                " WHERE round = ? AND status = 'pending' ORDER BY submitted",
+                (round_no,)).fetchall()
+        return [(r[0], json.loads(r[1])) for r in rows]
+
+    def pending_count(self) -> int:
+        with self._lock:
+            (n,) = self._conn.execute(
+                "SELECT COUNT(*) FROM timelock WHERE status = 'pending'"
+            ).fetchone()
+        return n
+
+    def finish_round(self, results: list[tuple[str, bool, bytes, str]]
+                     ) -> tuple[int, int]:
+        """Persist a whole round's open outcomes in ONE transaction:
+        ``(token, ok, plaintext, error)`` rows become opened/rejected.
+        Returns (opened, rejected) counts. Only ``pending`` rows
+        transition (immutability as in :meth:`_finish`); rows already
+        decided by a concurrent sweep are skipped, not an error."""
+        now = time.time()
+        opened = rejected = 0
+        with self._lock:
+            for token, ok, plaintext, error in results:
+                cur = self._conn.execute(
+                    "UPDATE timelock SET status = ?, plaintext = ?,"
+                    " error = ?, opened = ?"
+                    " WHERE id = ? AND status = 'pending'",
+                    ("opened" if ok else "rejected",
+                     plaintext if ok else None,
+                     None if ok else (error or "")[:300], now, token))
+                if cur.rowcount == 1:
+                    if ok:
+                        opened += 1
+                    else:
+                        rejected += 1
+            self._conn.commit()
+        return opened, rejected
+
+    def set_opened(self, token: str, plaintext: bytes) -> None:
+        self._finish(token, "opened", plaintext, None)
+
+    def set_rejected(self, token: str, error: str) -> None:
+        self._finish(token, "rejected", None, error[:300])
+
+    def _finish(self, token: str, status: str, plaintext: bytes | None,
+                error: str | None) -> None:
+        """pending -> opened|rejected, exactly once (opened rows are
+        immutable — the HTTP layer's ETag depends on it)."""
+        with self._lock:
+            cur = self._conn.execute(
+                "UPDATE timelock SET status = ?, plaintext = ?, error = ?,"
+                " opened = ? WHERE id = ? AND status = 'pending'",
+                (status, plaintext, error, time.time(), token))
+            self._conn.commit()
+            if cur.rowcount != 1:
+                raise VaultError(
+                    f"ciphertext {token} is not pending (double open?)")
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()

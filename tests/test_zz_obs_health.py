@@ -539,6 +539,7 @@ def test_engine_introspect_json_shape():
         eng._rlc_ok = {("g2g2", 8): True}
         eng._wire_rlc_ok = {32: True}
         eng._wire_rlc_sharded_ok = {}
+        eng._tl_ok = {8: True}
         eng._eval_ok = {(2, 32): True}
         eng._poly_eval_ok = {}
         eng._agg_ok = {(4, 8, 255): False}
@@ -547,7 +548,8 @@ def test_engine_introspect_json_shape():
     assert data["backend"]
     kat = data["kat"]
     assert set(kat) == {"verify", "wire", "rlc", "wire_rlc",
-                        "wire_rlc_sharded", "eval", "poly_eval", "agg"}
+                        "wire_rlc_sharded", "timelock", "eval",
+                        "poly_eval", "agg"}
     for family in kat.values():
         for k, v in family.items():
             assert isinstance(k, str) and isinstance(v, bool)

@@ -26,9 +26,14 @@ DEFAULT_LEAVES: tuple[tuple[str, str, str], ...] = (
     # pairing-class: multi-ms to seconds per call — never on the loop
     (r"^drand_tpu\.crypto\.pairing\.", "high", "pairing"),
     (r"^drand_tpu\.crypto\.batch\.(verify_beacons|verify_partials|"
-     r"verify_recovered_many|recover|aggregate_round|eval_commits)$",
+     r"verify_recovered_many|recover|aggregate_round|eval_commits|"
+     r"decrypt_round_batch)$",
      "high", "engine dispatch"),
     (r"^drand_tpu\.crypto\.batch_verify\.", "high", "RLC batch verify"),
+    # timelock IBE: encrypt/decrypt are one pairing each, the batch
+    # entrypoints a whole round's worth — never inline on the loop
+    (r"^drand_tpu\.crypto\.timelock\.(encrypt|decrypt|decrypt_batch)$",
+     "high", "timelock IBE"),
     (r"^drand_tpu\.crypto\.tbls\.(verify_partial|verify_recovered|"
      r"recover|aggregate)", "high", "threshold BLS"),
     (r"^drand_tpu\.chain\.beacon\.verify_beacon", "high", "beacon verify"),
@@ -59,6 +64,12 @@ DEFAULT_ATTR_LEAVES: dict[str, tuple[str, str]] = {
     "miller_loop": ("high", "pairing"),
     "pairing_check": ("high", "pairing"),
     "pairing_check_groups": ("high", "pairing"),
+    # timelock batch entrypoints (ISSUE 9): a future `async def` that
+    # decrypts a round inline on the event loop is a HIGH finding
+    "decrypt_round_batch": ("high", "timelock batch decrypt"),
+    "decrypt_batch": ("high", "timelock batch decrypt"),
+    "decrypt_many": ("high", "timelock batch decrypt"),
+    "timelock_open": ("high", "timelock batch decrypt"),
 }
 
 # functions whose bodies are exempt (test scaffolding has no production

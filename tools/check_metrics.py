@@ -54,12 +54,19 @@ _SAMPLE_SUFFIXES = ("_total", "_bucket", "_sum", "_count")
 # pairs per catch-up span); "wire_rlc_sharded" is the same tier with
 # the combine sharded over the batch axis of the engine mesh (one
 # cross-shard reduction, still one pairing row per span).
+# "host_shared" is the timelock round-open host tier
+# (crypto/timelock.decrypt_batch: one shared-signature Miller-line
+# precomputation for the whole round, per-item evaluation only).
 KNOWN_ENGINE_PATHS = {"host", "device", "host_rlc", "wire_rlc",
-                      "wire_rlc_sharded"}
+                      "wire_rlc_sharded", "host_shared"}
 # known label VALUES per labelled counter whose cardinality is a fixed
 # enum (new values need a deliberate catalogue update here + README)
-KNOWN_LABEL_VALUES = {"hash_to_g2_cache_requests": {"result": {"hit",
-                                                               "miss"}}}
+KNOWN_LABEL_VALUES = {
+    "hash_to_g2_cache_requests": {"result": {"hit", "miss"}},
+    "timelock_gt_cache_requests": {"result": {"hit", "miss"}},
+    "timelock_ciphertexts_total": {"result": {"submitted", "opened",
+                                              "rejected"}},
+}
 
 
 def _declarations() -> list[tuple[str, str, str]]:
