@@ -486,6 +486,73 @@ def bench_obs_overhead(trials):
             "spans_per_pass": span, "vs_baseline": None}
 
 
+def bench_flight_overhead(trials):
+    """Flight-recorder overhead A/B on a 64-round follow (ISSUE 10):
+    the same 64-beacon verify-and-advance loop run bare vs with the
+    flight recorder fed the way the live ingest path feeds it — t
+    partial events + the quorum note + recover/store milestones per
+    round (DENSER than a real follow, which records nothing for
+    historical rounds — this bounds the live path's cost from above).
+    Pure host crypto, runs before backend init; acceptance is ≤2%."""
+    from drand_tpu.chain import beacon as chain_beacon
+    from drand_tpu.chain.beacon import Beacon, message
+    from drand_tpu.crypto import bls
+    from drand_tpu.obs.flight import FlightRecorder
+
+    span, t_of_n = 64, 3
+    period, genesis = 10, 1_000_000
+    sk, pub = bls.keygen(seed=b"bench-flight")
+    prev, beacons = b"\x52" * 32, []
+    for rnd in range(1, span + 1):
+        sig = bls.sign(sk, message(rnd, prev))  # warms the h2c memo too
+        beacons.append(Beacon(round=rnd, previous_sig=prev, signature=sig))
+        prev = sig
+
+    def verify_all():
+        for b in beacons:
+            if not chain_beacon.verify_beacon(pub, b):
+                raise RuntimeError("verification failed")
+
+    def timed_bare():
+        t0 = time.perf_counter()
+        verify_all()
+        return time.perf_counter() - t0
+
+    flight = FlightRecorder()
+
+    def timed_instrumented():
+        flight.reset()
+        t0 = time.perf_counter()
+        for b in beacons:
+            boundary = genesis + (b.round - 1) * period
+            for idx in range(t_of_n):
+                flight.note_partial(
+                    b.round, index=idx, source="grpc", verdict="valid",
+                    now=boundary + 0.1 * idx, period=period,
+                    genesis=genesis, n=t_of_n + 1, threshold=t_of_n)
+            flight.note_quorum(b.round, have=t_of_n, threshold=t_of_n,
+                               now=boundary + 0.3, period=period,
+                               genesis=genesis)
+            flight.note_milestone(b.round, "recover", now=boundary + 0.4,
+                                  period=period, genesis=genesis)
+            if not chain_beacon.verify_beacon(pub, b):
+                raise RuntimeError("verification failed")
+            flight.note_milestone(b.round, "store", now=boundary + 0.5,
+                                  period=period, genesis=genesis)
+        return time.perf_counter() - t0
+
+    trials = min(trials, 3)
+    dt_bare = best_of(trials, timed_bare)
+    dt_flight = best_of(trials, timed_instrumented)
+    overhead_pct = (dt_flight - dt_bare) / dt_bare * 100.0
+    return {"metric": "flight_overhead", "value": round(overhead_pct, 2),
+            "unit": "%", "span": span,
+            "events_per_round": t_of_n + 3,
+            "bare_seconds": round(dt_bare, 4),
+            "instrumented_seconds": round(dt_flight, 4),
+            "vs_baseline": None}
+
+
 def bench_msm_pippenger(trials):
     """Host MSM strategy A/B on a 64-point G2 span with 128-bit RLC
     scalars: the ψ-endomorphism-split Pippenger (crypto/batch_verify.msm
@@ -798,7 +865,7 @@ def main() -> None:
     t_start = time.perf_counter()
     which = os.environ.get(
         "BENCH_CONFIGS",
-        "msm,glv4,rlc,obs,timelock,shard,e2e,catchup,recover,deal,"
+        "msm,glv4,rlc,obs,flight,timelock,shard,e2e,catchup,recover,deal,"
         "replay,headline").split(",")
 
     # --- outage-proofing (round-3 lesson: the official record must never
@@ -898,6 +965,16 @@ def main() -> None:
 
             log(traceback.format_exc())
             diag("aux_config_failed", config="obs",
+                 error=f"{type(e).__name__}: {e}")
+    if "flight" in which:
+        log("== flight-recorder overhead on a 64-round follow ==")
+        try:
+            emit(bench_flight_overhead(trials))
+        except Exception as e:  # noqa: BLE001 — best-effort aux config
+            import traceback
+
+            log(traceback.format_exc())
+            diag("aux_config_failed", config="flight",
                  error=f"{type(e).__name__}: {e}")
 
     if "timelock" in which:

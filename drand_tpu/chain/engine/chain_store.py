@@ -15,6 +15,7 @@ from dataclasses import dataclass
 from ...crypto import batch
 from ...net.packets import PartialBeaconPacket
 from ...net.transport import ProtocolClient
+from ...obs.flight import FLIGHT
 from ...obs.trace import TRACER
 from ...utils.aio import spawn
 from ...utils.logging import KVLogger
@@ -126,6 +127,19 @@ class ChainStore(CallbackStore):
                       round=rc.round, have=f"{len(rc)}/{thr}")
         if len(rc) < thr:
             return last
+        # the t-th valid partial is in: quorum time + margin SLI. The
+        # recorder dedups (first quorum wins), and the recover-dispatch
+        # milestone rides the same gate — straggler partials past the
+        # threshold re-enter here while the first aggregation is still
+        # on its worker thread and must not append duplicate milestones
+        if FLIGHT.note_quorum(rc.round, have=len(rc), threshold=thr,
+                              now=self._conf.clock.now(),
+                              period=self._conf.group.period,
+                              genesis=self._conf.group.genesis_time, n=n):
+            FLIGHT.note_milestone(rc.round, "recover",
+                                  now=self._conf.clock.now(),
+                                  period=self._conf.group.period,
+                                  genesis=self._conf.group.genesis_time)
         new_beacon = await self._aggregate(rc, thr, n)
         if new_beacon is None:
             return last
@@ -201,6 +215,10 @@ class ChainStore(CallbackStore):
         except StoreError as e:
             self._l.error("aggregator", "error_storing", err=str(e))
             return False
+        FLIGHT.note_milestone(new_beacon.round, "store",
+                              now=self._conf.clock.now(),
+                              period=self._conf.group.period,
+                              genesis=self._conf.group.genesis_time)
         try:
             self.catchup_beacons.put_nowait(new_beacon)
         except asyncio.QueueFull:

@@ -16,6 +16,7 @@ from ...key.group import Group
 from ...key.keys import Node, Share
 from ...net.packets import PartialBeaconPacket, SyncRequest
 from ...net.transport import ProtocolClient, ProtocolService, TransportError
+from ...obs.flight import FLIGHT
 from ...obs.trace import TRACER
 from ...utils.aio import spawn
 from ...utils.clock import Clock
@@ -145,6 +146,22 @@ class Handler(ProtocolService):
         await self.conf.clock.sleep(stop_time - now)
         self.stop()
 
+    def _note_flight(self, p: PartialBeaconPacket, verdict: str,
+                     source: str = "grpc", sender: str | None = None) -> None:
+        """Record one partial-ingress event in the flight recorder — a
+        ring append under one lock, no crypto, stays on the loop. The
+        index prefix is untrusted bytes: a malformed prefix records as
+        an unattributed event rather than raising."""
+        try:
+            idx = tbls.index_of(p.partial_sig)
+        except ValueError:
+            idx = None
+        g = self.conf.group
+        FLIGHT.note_partial(p.round, index=idx, source=source,
+                            verdict=verdict, now=self.conf.clock.now(),
+                            period=g.period, genesis=g.genesis_time,
+                            n=len(g), threshold=g.threshold, sender=sender)
+
     # ------------------------------------------------------- service surface
     async def process_partial_beacon(self, from_addr: str,
                                      p: PartialBeaconPacket) -> None:
@@ -158,6 +175,7 @@ class Handler(ProtocolService):
         if p.round > next_round:
             self._l.error("process_partial", from_addr, invalid_future_round=p.round,
                           current_round=current_round)
+            self._note_flight(p, "future", sender=from_addr)
             raise TransportError(
                 f"invalid round: {p.round} instead of {current_round}")
         # stale partials are rejected BEFORE paying for pairings: anything
@@ -170,6 +188,7 @@ class Handler(ProtocolService):
         if not (last_round < p.round <= last_round + PARTIAL_CACHE_STORE_LIMIT + 1):
             self._l.debug("process_partial", from_addr, stale_round=p.round,
                           last=last_round)
+            self._note_flight(p, "stale", sender=from_addr)
             raise TransportError(
                 f"stale round: {p.round} (chain at {last_round})")
         with TRACER.activate(round_no=p.round,
@@ -185,10 +204,12 @@ class Handler(ProtocolService):
             if err is not None:
                 self._l.error("process_partial", from_addr, err=err,
                               round=p.round)
+                self._note_flight(p, "invalid", sender=from_addr)
                 raise TransportError(err)
             if tbls.index_of(p.partial_sig) == self.crypto.index():
                 # a reflected copy of our own partial: ignore
                 return
+            self._note_flight(p, "valid", sender=from_addr)
             self.chain.new_valid_partial(from_addr, p)
 
     def sync_chain(self, from_addr: str, req: SyncRequest) -> AsyncIterator[Beacon]:
@@ -276,6 +297,7 @@ class Handler(ProtocolService):
                     partial_sig_v2=sig_v2,
                 )
             self._l.debug("broadcast_partial", round=round_no)
+            self._note_flight(packet, "valid", source="self")
             self.chain.new_valid_partial(self.addr, packet)
             # tasks created inside the activate block copy the trace
             # context, so the outbound calls carry the traceparent

@@ -17,6 +17,7 @@ through its localhost control port (cmd/drand-cli/control.go), exactly like
     python -m drand_tpu.cli util trace --url http://host:port [--n K]
     python -m drand_tpu.cli util trace --merge http://a:port http://b:port
     python -m drand_tpu.cli util engine --url http://host:port
+    python -m drand_tpu.cli util flight --url http://host:port [--dkg]
     python -m drand_tpu.cli stop --control PORT
 """
 
@@ -92,6 +93,11 @@ async def _run_daemon(args) -> None:
                   dkg_timeout=args.dkg_timeout)
     d = Drand.load(ks, conf, None, logger)
     priv_addr = args.private_listen or d.priv.public.addr
+    # span resource attrs carry the node address ONLY under
+    # DRAND_TPU_OTLP_NODE_ATTRS=1 (privacy rationale in obs/export.py)
+    from ..obs import export as obs_export
+
+    obs_export.set_node_address(d.priv.public.addr)
     tls_pair = None
     certs = None
     if args.tls:
@@ -390,6 +396,73 @@ def _print_merged_timeline(merged: list[dict]) -> None:
         print()
 
 
+def _print_flight_matrix(data: dict) -> None:
+    """Render /debug/flight/rounds as the rounds × nodes contribution
+    matrix: # on-time, ~ late, ! invalid, . missing (obs/flight.py
+    bitmap encoding), with the quorum margin per round."""
+    rounds = data.get("rounds", [])
+    if not rounds:
+        print("no flight records yet (no partials seen)")
+        return
+    width = max((len(r.get("bitmap") or "") for r in rounds), default=0)
+    idx_hdr = " ".join(str(i % 10) for i in range(width))
+    print("contribution matrix (# on-time  ~ late  ! invalid  . missing)")
+    print(f"{'round':>10}  {idx_hdr:<{2 * width}}  "
+          f"{'margin_s':>9}  quorum")
+    for rec in rounds:
+        bitmap = rec.get("bitmap") or ""
+        cells = " ".join(bitmap) if bitmap else "?"
+        margin = rec.get("margin_s")
+        margin_s = f"{margin:9.3f}" if margin is not None else "        -"
+        quorum = "-"
+        for m in rec.get("milestones", []):
+            if m.get("name") == "quorum":
+                quorum = (f"{m.get('have')}/{rec.get('threshold')} "
+                          f"@ +{m.get('offset_s'):.3f}s")
+        print(f"{rec.get('round'):>10}  {cells:<{2 * width}}  "
+              f"{margin_s}  {quorum}")
+    peers = data.get("peers") or {}
+    if peers:
+        print(f"\n{'index':>6}  {'contributed':>11}  {'late':>6}  "
+              f"{'invalid':>7}")
+        for idx, st in peers.items():
+            print(f"{idx:>6}  {st.get('contributed', 0):>11}  "
+                  f"{st.get('late', 0):>6}  {st.get('invalid', 0):>7}")
+
+
+def _print_flight_dkg(data: dict) -> None:
+    """Render /debug/flight/dkg session timelines."""
+    sessions = data.get("sessions", [])
+    if not sessions:
+        print("no DKG sessions recorded in this process")
+        return
+    for s in sessions:
+        head = (f"dkg session {s.get('session')}  mode={s.get('mode')}  "
+                f"dealers={s.get('n_dealers')} "
+                f"receivers={s.get('n_receivers')} "
+                f"threshold={s.get('threshold')}")
+        if not s.get("done"):
+            head += "  [RUNNING]"
+        elif s.get("error"):
+            head += f"  [FAILED: {s['error']}]"
+        print(head)
+        for ph in s.get("phases", []):
+            end = ph.get("end_s")
+            dur = (f"{end - ph['start_s']:8.3f}s"
+                   if end is not None else "    open")
+            seen = s.get("bundles", {}).get(ph["phase"], {})
+            arrivals = " ".join(
+                f"{i}@+{off:.3f}s" for i, off in
+                sorted(seen.items(), key=lambda kv: kv[1]))
+            print(f"  +{ph['start_s']:8.3f}s  {ph['phase']:<14} {dur}"
+                  f"  {arrivals}")
+        if s.get("qual") is not None:
+            print(f"  QUAL: {s['qual']}")
+        if s.get("complaints"):
+            print(f"  open complaints: {s['complaints']}")
+        print()
+
+
 def _print_engine_state(data: dict) -> None:
     print(f"dispatch mode: {data.get('mode')}  "
           f"min_batch={data.get('min_batch')}  "
@@ -454,6 +527,29 @@ def cmd_util(args) -> None:
                 _print_trace_timeline(payloads[0])
 
         asyncio.run(run_trace())
+        return
+    if args.what == "flight":
+        # threshold flight recorder: rounds × nodes contribution matrix
+        # (or --dkg for the DKG phase timeline) from /debug/flight/*
+        if not args.url:
+            raise SystemExit("util flight requires --url http://host:port")
+
+        async def run_flight():
+            if args.dkg:
+                data = await _fetch_json(args.url, "/debug/flight/dkg")
+                if args.json:
+                    print(json.dumps(data, indent=2))
+                else:
+                    _print_flight_dkg(data)
+            else:
+                data = await _fetch_json(args.url, "/debug/flight/rounds",
+                                         n=args.n)
+                if args.json:
+                    print(json.dumps(data, indent=2))
+                else:
+                    _print_flight_matrix(data)
+
+        asyncio.run(run_flight())
         return
     if args.what == "engine":
         # engine introspection of a running node (/debug/engine):
@@ -787,6 +883,30 @@ def cmd_relay_archive(args) -> None:
         given_up: set[int] = set()
         heal_fails: dict[int, int] = {}
         GIVE_UP_AFTER = 5  # heal cycles before a round is abandoned
+        SHIP_EVERY = 64    # archived rounds between spool shipments
+
+        # OTLP spool shipping (the ISSUE-6 follow-on): an archive relay
+        # is the natural offline shipper — when both env vars are set,
+        # re-POST the spooled traces in batches at start and every
+        # SHIP_EVERY archived rounds (truncated on success; a dead
+        # collector leaves the spool for the next cycle)
+        ship_spool_path = os.environ.get("DRAND_TPU_OTLP_SPOOL") or None
+        ship_endpoint = os.environ.get("DRAND_TPU_OTLP_ENDPOINT") or None
+
+        async def ship_traces() -> None:
+            if not (ship_spool_path and ship_endpoint):
+                return
+            from ..obs import export as obs_export
+
+            try:
+                out = await obs_export.ship_spool(ship_spool_path,
+                                                  ship_endpoint)
+            except Exception as e:  # noqa: BLE001 — telemetry shipping
+                # must never take down beacon archiving
+                print(f"otlp spool ship failed: {e!r}", flush=True)
+                return
+            if out["batches"] or not out["ok"]:
+                print(f"otlp spool ship: {out}", flush=True)
 
         async def fetch_span(start: int, end: int, width: int = 16,
                              attempts: int = 3) -> None:
@@ -830,11 +950,17 @@ def cmd_relay_archive(args) -> None:
                 await fetch_span(args.sync_from or 1, latest)
                 print(f"backfilled rounds {args.sync_from or 1}..{latest}",
                       flush=True)
+            await ship_traces()
             if args.once:
                 return
+            since_ship = 0
             async for r in client.watch():
                 put(r)
                 print(f"archived round {r.round}", flush=True)
+                since_ship += 1
+                if since_ship >= SHIP_EVERY:
+                    since_ship = 0
+                    await ship_traces()
                 # heal any hole between the watermark and this round
                 # (rounds produced during backfill, watch hiccups). A
                 # transient source outage is retried across GIVE_UP_AFTER
@@ -942,7 +1068,7 @@ def main(argv=None) -> None:
     u = sub.add_parser("util")
     u.add_argument("what", choices=["ping", "check", "del-beacon",
                                     "self-sign", "reset", "trace",
-                                    "engine"])
+                                    "engine", "flight"])
     u.add_argument("--control", type=int, default=8888)
     u.add_argument("--address")
     u.add_argument("--folder")
@@ -955,10 +1081,14 @@ def main(argv=None) -> None:
                         "interleave spans sharing a trace id into one "
                         "cross-node timeline")
     u.add_argument("--n", type=int, default=8,
-                   help="round timelines to fetch (trace)")
+                   help="round timelines/flight records to fetch "
+                        "(trace/flight)")
+    u.add_argument("--dkg", action="store_true",
+                   help="flight: show the DKG phase timeline instead "
+                        "of the round matrix")
     u.add_argument("--json", action="store_true",
                    help="raw JSON instead of the pretty rendering "
-                        "(trace/engine)")
+                        "(trace/engine/flight)")
     u.set_defaults(fn=cmd_util)
 
     an = sub.add_parser("analyze",

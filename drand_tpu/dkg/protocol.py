@@ -36,6 +36,7 @@ from ..crypto.curves import PointG1
 from ..crypto.fields import R
 from ..crypto.poly import PriPoly, PriShare, PubPoly, lagrange_coefficients
 from ..key.keys import Node, Pair
+from ..obs.flight import FLIGHT
 from ..utils.clock import Clock, SystemClock
 from ..utils.logging import KVLogger, default_logger
 from .board import Board
@@ -121,50 +122,88 @@ class DKGProtocol:
 
     # ------------------------------------------------------------------ run
     async def run(self) -> DistKeyShare:
-        """Execute all phases; returns the distributed key share."""
+        """Execute all phases; returns the distributed key share.
+
+        Every phase transition, bundle arrival (by issuer index) and
+        the QUAL outcome land in the flight recorder's DKG timeline
+        (``/debug/flight/dkg``) — indices and clock offsets only, never
+        shares or key material — so a wedged DKG names the phase and
+        the silent dealers instead of demanding log archaeology."""
         dealers = self.c.dealers()
         n_recv = len(self.c.new_nodes)
+        sid = FLIGHT.dkg.begin(
+            self.c.nonce, mode="reshare" if self.c.resharing else "dkg",
+            n_dealers=len(dealers), n_receivers=n_recv,
+            threshold=self.c.threshold, now=self.c.clock.now(),
+            # role-qualified tag: in a reshare an old-only dealer and a
+            # new receiver can share the same numeric index — their
+            # in-process timelines must not collide
+            tag=(f"s{self._share_index}" if self._share_index is not None
+                 else f"d{self._dealer_index}"))
+        try:
+            FLIGHT.dkg.note_phase(sid, "deal", now=self.c.clock.now())
+            my_poly = None
+            if self._dealer_index is not None:
+                my_poly = self._make_poly()
+                await self.board.push_deals(self._make_deal_bundle(my_poly))
 
-        my_poly = None
-        if self._dealer_index is not None:
-            my_poly = self._make_poly()
-            await self.board.push_deals(self._make_deal_bundle(my_poly))
+            deals = await self._collect(
+                self.board.deals, expect=len(dealers),
+                issuer=lambda b: b.dealer_index,
+                note=lambda b: FLIGHT.dkg.note_bundle(
+                    sid, "deal", b.dealer_index, now=self.c.clock.now()))
+            # deliberately INLINE (loopblock baseline entry): deal
+            # admission is a batched commitment evaluation + point
+            # muls, but the DKG runs in a dedicated phase-clock-driven
+            # setup window — an executor hand-off here suspends the
+            # node between a phase deadline and its response push, and
+            # a concurrently advancing clock (FakeClock tests;
+            # aggressive operator timeouts) can close the response
+            # window while the thread runs. Bounded: one batched eval
+            # per DKG, not per round.
+            self._process_deals(deals)
 
-        deals = await self._collect(
-            self.board.deals, expect=len(dealers),
-            issuer=lambda b: b.dealer_index)
-        # deliberately INLINE (loopblock baseline entry): deal admission
-        # is a batched commitment evaluation + point muls, but the DKG
-        # runs in a dedicated phase-clock-driven setup window — an
-        # executor hand-off here suspends the node between a phase
-        # deadline and its response push, and a concurrently advancing
-        # clock (FakeClock tests; aggressive operator timeouts) can
-        # close the response window while the thread runs. Bounded: one
-        # batched eval per DKG, not per round.
-        self._process_deals(deals)
+            FLIGHT.dkg.note_phase(sid, "response", now=self.c.clock.now())
+            if self._share_index is not None:
+                await self.board.push_responses(
+                    self._make_response_bundle(dealers))
+            responses = await self._collect(
+                self.board.responses, expect=n_recv,
+                issuer=lambda b: b.share_index,
+                note=lambda b: FLIGHT.dkg.note_bundle(
+                    sid, "response", b.share_index, now=self.c.clock.now()))
+            for b in responses:
+                self._process_response(b, dealers)
 
-        if self._share_index is not None:
-            await self.board.push_responses(self._make_response_bundle(dealers))
-        responses = await self._collect(
-            self.board.responses, expect=n_recv,
-            issuer=lambda b: b.share_index)
-        for b in responses:
-            self._process_response(b, dealers)
+            any_complaints = any(self._complaints_open.values())
+            if any_complaints:
+                FLIGHT.dkg.note_phase(sid, "justification",
+                                      now=self.c.clock.now())
+                if self._dealer_index is not None and \
+                        self._complaints_open.get(self._dealer_index):
+                    await self.board.push_justifications(
+                        self._make_justification_bundle(my_poly))
+                complained = [d for d, s in self._complaints_open.items()
+                              if s]
+                justs = await self._collect(
+                    self.board.justifications, expect=len(complained),
+                    issuer=lambda b: b.dealer_index,
+                    note=lambda b: FLIGHT.dkg.note_bundle(
+                        sid, "justification", b.dealer_index,
+                        now=self.c.clock.now()))
+                for b in justs:
+                    self._process_justification(b)
 
-        any_complaints = any(self._complaints_open.values())
-        if any_complaints:
-            if self._dealer_index is not None and \
-                    self._complaints_open.get(self._dealer_index):
-                await self.board.push_justifications(
-                    self._make_justification_bundle(my_poly))
-            complained = [d for d, s in self._complaints_open.items() if s]
-            justs = await self._collect(
-                self.board.justifications, expect=len(complained),
-                issuer=lambda b: b.dealer_index)
-            for b in justs:
-                self._process_justification(b)
-
-        return self._finish(dealers)
+            FLIGHT.dkg.note_phase(sid, "finish", now=self.c.clock.now())
+            result = self._finish(dealers)
+        except BaseException as e:
+            FLIGHT.dkg.finish(sid, now=self.c.clock.now(),
+                              complaints=self._complaints_open,
+                              error=repr(e))
+            raise
+        FLIGHT.dkg.finish(sid, now=self.c.clock.now(), qual=result.qual,
+                          complaints=self._complaints_open)
+        return result
 
     # ------------------------------------------------------------- dealing
     def _make_poly(self) -> PriPoly:
@@ -346,9 +385,12 @@ class DKGProtocol:
         return DistKeyShare(commits=commits, pri_share=pri, qual=qual)
 
     # ------------------------------------------------------------- plumbing
-    async def _collect(self, queue: asyncio.Queue, expect: int, issuer):
+    async def _collect(self, queue: asyncio.Queue, expect: int, issuer,
+                       note=None):
         """Drain a board queue until the phase times out — or, under
-        fast-sync, as soon as `expect` distinct issuers have arrived."""
+        fast-sync, as soon as `expect` distinct issuers have arrived.
+        ``note`` is called with each newly-accepted bundle AS IT
+        ARRIVES (the flight recorder's per-issuer arrival offsets)."""
         items: list = []
         seen: set[int] = set()
         deadline = asyncio.ensure_future(self._phaser.next_phase())
@@ -364,6 +406,8 @@ class DKGProtocol:
                     if issuer(b) not in seen:
                         seen.add(issuer(b))
                         items.append(b)
+                        if note is not None:
+                            note(b)
                 else:
                     get.cancel()
                 if deadline in done:

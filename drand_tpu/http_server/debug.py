@@ -41,11 +41,14 @@ def add_debug_routes(app: web.Application) -> None:
 
 
 def add_trace_routes(app: web.Application) -> None:
-    """The always-on introspection surface: round timelines + engine
-    state (both are dict reads — no profiling cost to gate)."""
+    """The always-on introspection surface: round timelines, engine
+    state and the threshold flight recorder (all dict reads — no
+    profiling cost to gate)."""
     app.add_routes([
         web.get("/debug/trace/rounds", _trace_rounds),
         web.get("/debug/engine", _engine_state),
+        web.get("/debug/flight/rounds", _flight_rounds),
+        web.get("/debug/flight/dkg", _flight_dkg),
     ])
 
 
@@ -65,6 +68,29 @@ async def _trace_rounds(request: web.Request) -> web.Response:
         return web.json_response({"error": "bad n"}, status=400)
     n = max(1, min(int(raw), TRACER.max_rounds))
     return web.json_response({"rounds": TRACER.rounds(n)})
+
+
+async def _flight_rounds(request: web.Request) -> web.Response:
+    """The flight recorder's per-round partial-arrival records
+    (`drand util flight` renders the rounds × nodes matrix from this).
+    ``n`` validates exactly like /debug/trace/rounds — plain base-10
+    only, clamped to [1, ring size]."""
+    from ..obs.flight import FLIGHT
+
+    raw = request.query.get("n", "16").strip()
+    if not re.fullmatch(r"[+-]?[0-9]+", raw):
+        return web.json_response({"error": "bad n"}, status=400)
+    n = max(1, min(int(raw), FLIGHT.max_rounds))
+    return web.json_response({"rounds": FLIGHT.rounds(n),
+                              "peers": FLIGHT.peers()})
+
+
+async def _flight_dkg(request: web.Request) -> web.Response:
+    """The flight recorder's DKG/reshare session timelines — phase
+    transitions, per-issuer bundle arrivals, QUAL evolution."""
+    from ..obs.flight import FLIGHT
+
+    return web.json_response({"sessions": FLIGHT.dkg.sessions()})
 
 
 async def _engine_state(request: web.Request) -> web.Response:

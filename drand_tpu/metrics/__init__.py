@@ -61,6 +61,23 @@ DiscrepancyStore on every stored beacon and re-evaluated by /healthz):
       throughput (0 when no follow is running)
   chain_sync_eta_seconds               [group]   follow_chain ETA to the
       target round (-1 = unbounded follow, 0 = idle/done)
+Threshold flight recorder (obs/flight.py, ISSUE 10 — fed by partial
+ingress, the aggregator, gossip validation and the DKG protocol):
+  beacon_quorum_margin_seconds         [group]   period minus the
+      arrival offset of the t-th valid partial — the distance-to-
+      missed-round early-warning SLI (negative = quorum after the
+      round's whole period had already passed)
+  beacon_partial_arrival_seconds{source} [group] valid partial/beacon
+      arrival offset from the round boundary by ingress source
+      (grpc | gossip | self)
+  beacon_partial_events_total{index,event} [group] per-share-index
+      contribution/lateness/invalid counters (event: contributed |
+      late | invalid; late = arrived more than period/2 after the
+      boundary; index cardinality is bounded by the group size)
+  beacon_contribution_gap              [group]   group size minus the
+      distinct valid contributors of the last stored round
+  dkg_phase_seconds{phase}             [group]   DKG/reshare phase
+      durations (deal | response | justification | finish)
 Engine introspection (ISSUE 6):
   engine_compile_seconds{op}           [private] FIRST dispatch of each
       (op, path, batch-bucket) device shape — the jit compile +
@@ -238,6 +255,41 @@ SYNC_ETA_SECONDS = Gauge(
     "Estimated seconds until follow_chain reaches its target round "
     "(-1 for an unbounded follow, 0 when idle/done)",
     registry=GROUP_REGISTRY)
+
+# ---- threshold flight recorder (obs/flight.py) ----------------------------
+# Margin spans "quorum landed instantly" (≈ period) down through "barely
+# made it" (≈ 0) to "quorum after the period elapsed" (negative) — the
+# negative buckets keep a dying group's rounds distinguishable from
+# healthy instant-quorum ones.
+_MARGIN_BUCKETS = (-60.0, -10.0, -1.0, 0.0, 0.25, 0.5, 1.0, 2.5, 5.0,
+                   10.0, 15.0, 30.0, 60.0)
+QUORUM_MARGIN = Histogram(
+    "beacon_quorum_margin_seconds",
+    "Round period minus the time-to-t-th-valid-partial — how far the "
+    "round stayed from missing quorum (the early-warning SLI)",
+    registry=GROUP_REGISTRY, buckets=_MARGIN_BUCKETS)
+PARTIAL_ARRIVAL = Histogram(
+    "beacon_partial_arrival_seconds",
+    "Valid partial/beacon arrival offset from the scheduled round "
+    "boundary, by ingress source (grpc|gossip|self)",
+    ["source"], registry=GROUP_REGISTRY, buckets=_LATENESS_BUCKETS)
+PARTIAL_EVENTS = Counter(
+    "beacon_partial_events_total",
+    "Per-share-index partial-signature events (contributed = valid "
+    "partial accepted; late = valid but more than period/2 after the "
+    "boundary; invalid = failed verification/window checks)",
+    ["index", "event"], registry=GROUP_REGISTRY)
+CONTRIBUTION_GAP = Gauge(
+    "beacon_contribution_gap",
+    "Group size minus the distinct valid contributors of the last "
+    "stored round (0 = full participation)",
+    registry=GROUP_REGISTRY)
+DKG_PHASE_SECONDS = Histogram(
+    "dkg_phase_seconds",
+    "DKG/reshare phase durations by phase "
+    "(deal|response|justification|finish)",
+    ["phase"], registry=GROUP_REGISTRY,
+    buckets=(0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0))
 
 # ---- OTLP export (obs/export.py) ------------------------------------------
 OTLP_EXPORT_ROUNDS = Counter(

@@ -22,6 +22,14 @@ Sinks, in order:
 With neither env var set the exporter is off — no surprise disk writes
 or sockets from library use.
 
+A spool written while the collector was down (or on an offline relay)
+is shipped later with :func:`ship_spool` — batch re-POST with
+retry/backoff, truncating the ring on full success (``drand
+relay-archive`` runs it when both env vars are set). Per-node resource
+attributes (``drand.node.address``) are exported ONLY under
+``DRAND_TPU_OTLP_NODE_ATTRS=1`` — see :func:`set_node_address` for the
+privacy rationale.
+
 Flushing is per COMPLETED round and never on the hot path: the store
 decorator calls :func:`note_round_complete`, which defers the ring
 lookup + serialization + I/O with ``loop.call_soon`` (so the round's
@@ -40,6 +48,31 @@ import threading
 from .trace import TRACER, round_trace_id
 
 _SPAN_KIND_INTERNAL = 1
+
+# per-node resource attrs (ISSUE 10 satellite; PR-6 follow-on). The
+# daemon registers its address at boot, but the attribute is OFF unless
+# DRAND_TPU_OTLP_NODE_ATTRS=1: exported spans may land on a SHARED or
+# public collector, and a node address on every span maps the group's
+# topology to whoever reads it — the same reason gossip spans carry a
+# keyed HASH of the sender instead of the raw peer IP. Operators who
+# run their own collector opt in explicitly.
+_NODE_ADDRESS: str | None = None
+
+
+def set_node_address(addr: str) -> None:
+    """Register this process's node address for span resource attrs
+    (only exported when DRAND_TPU_OTLP_NODE_ATTRS=1 — see above)."""
+    global _NODE_ADDRESS
+    _NODE_ADDRESS = addr
+
+
+def _node_resource_attrs() -> dict:
+    """Read at EXPORT time, not exporter construction: the daemon may
+    register its address after the first env-configured exporter was
+    built, and tests flip the env per case."""
+    if os.environ.get("DRAND_TPU_OTLP_NODE_ATTRS") == "1" and _NODE_ADDRESS:
+        return {"drand.node.address": _NODE_ADDRESS}
+    return {}
 
 
 def _attr(key: str, value) -> dict:
@@ -91,7 +124,10 @@ def round_to_otlp(rec: dict, resource_attrs: dict | None = None) -> dict:
 
 def read_spool(path: str) -> list[dict]:
     """Parse the NDJSON spool (current file plus the rotated ``.1`` when
-    present, oldest first) back into OTLP export dicts."""
+    present, oldest first) back into OTLP export dicts. Unparseable
+    lines are skipped: a daemon killed mid-append leaves a truncated
+    final line, and one bad telemetry line must never wedge a consumer
+    (the relay-archive shipper runs this on every ship cycle)."""
     out: list[dict] = []
     for p in (path + ".1", path):
         if not os.path.isfile(p):
@@ -99,8 +135,12 @@ def read_spool(path: str) -> list[dict]:
         with open(p, encoding="utf-8") as f:
             for line in f:
                 line = line.strip()
-                if line:
+                if not line:
+                    continue
+                try:
                     out.append(json.loads(line))
+                except ValueError:
+                    continue
     return out
 
 
@@ -110,9 +150,7 @@ class OTLPExporter:
                  max_spool_bytes: int = 4 << 20,
                  resource_attrs: dict | None = None,
                  timeout: float = 5.0):
-        self.endpoint = endpoint
-        if endpoint and not endpoint.rstrip("/").endswith("/v1/traces"):
-            self.endpoint = endpoint.rstrip("/") + "/v1/traces"
+        self.endpoint = _endpoint_url(endpoint) if endpoint else endpoint
         self.spool_path = spool_path
         self.max_spool_bytes = max_spool_bytes
         self.resource_attrs = dict(resource_attrs or {})
@@ -184,9 +222,13 @@ class OTLPExporter:
             return False
 
     # ------------------------------------------------------------ export
+    def _payload(self, rec: dict) -> dict:
+        return round_to_otlp(rec, {**self.resource_attrs,
+                                   **_node_resource_attrs()})
+
     def export_round_sync(self, rec: dict) -> str:
         """Spool-only synchronous export (no loop): 'spool'/'dropped'."""
-        payload = round_to_otlp(rec, self.resource_attrs)
+        payload = self._payload(rec)
         sink = "spool" if self.spool(payload) else "dropped"
         self._count(sink)
         return sink
@@ -194,13 +236,80 @@ class OTLPExporter:
     async def export_round(self, rec: dict) -> str:
         """POST when an endpoint is configured, spool as the fallback
         (and as the primary sink when no endpoint is set)."""
-        payload = round_to_otlp(rec, self.resource_attrs)
+        payload = self._payload(rec)
         if self.endpoint and await self._post(payload):
             self._count("http")
             return "http"
         sink = "spool" if self.spool(payload) else "dropped"
         self._count(sink)
         return sink
+
+
+def _endpoint_url(endpoint: str) -> str:
+    """Normalize a collector base URL to its /v1/traces path (the same
+    rule the exporter applies)."""
+    if endpoint.rstrip("/").endswith("/v1/traces"):
+        return endpoint
+    return endpoint.rstrip("/") + "/v1/traces"
+
+
+async def ship_spool(path: str, endpoint: str, *, batch_size: int = 32,
+                     attempts: int = 3, backoff: float = 0.5,
+                     timeout: float = 10.0) -> dict:
+    """Ship a spooled NDJSON ring to a collector: batch re-POST of
+    :func:`read_spool` output, with per-batch retry/backoff, and spool
+    truncation on FULL success (the relay-archive follow-on from
+    ISSUE 6).
+
+    Each batch merges up to ``batch_size`` spooled export requests'
+    ``resourceSpans`` into one OTLP/JSON request (the protocol is a
+    list — a collector ingests the merge exactly as it would the
+    originals). A batch that still fails after ``attempts`` tries
+    aborts the ship and LEAVES the spool intact (already-shipped
+    batches are re-sent next time: re-POSTing a span is idempotent for
+    any store keyed on span ids, and losing traces is worse). On full
+    success both ring files are deleted. Caller owns exclusivity — the
+    shipper is for offline/relay processes, not a live exporter's own
+    spool."""
+    import aiohttp
+
+    from .. import metrics
+
+    docs = read_spool(path)
+    if not docs:
+        return {"shipped": 0, "batches": 0, "ok": True}
+    url = _endpoint_url(endpoint)
+    shipped = 0
+    batches = 0
+    async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=timeout)) as s:
+        for lo in range(0, len(docs), batch_size):
+            chunk = docs[lo:lo + batch_size]
+            payload = {"resourceSpans": [rs for doc in chunk
+                                         for rs in
+                                         doc.get("resourceSpans", [])]}
+            ok = False
+            for attempt in range(attempts):
+                try:
+                    async with s.post(url, json=payload) as r:
+                        ok = r.status < 300
+                except Exception:  # noqa: BLE001 — collector outage
+                    ok = False
+                if ok:
+                    break
+                await asyncio.sleep(backoff * (2 ** attempt))
+            if not ok:
+                return {"shipped": shipped, "batches": batches,
+                        "ok": False, "failed_at": lo}
+            shipped += len(chunk)
+            batches += 1
+    for p in (path, path + ".1"):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+    metrics.OTLP_EXPORT_ROUNDS.labels(sink="http").inc(shipped)
+    return {"shipped": shipped, "batches": batches, "ok": True}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,462 @@
+"""Threshold flight recorder: per-partial arrival telemetry, quorum
+margins, and DKG phase timelines (ISSUE 10).
+
+The paper's liveness property is t-of-n partial collection every
+period, but the PR-1/PR-6 layers only see a round AFTER it aggregates
+— span latency cannot answer "which node is chronically late?", "how
+close did round R come to missing quorum?", or "where did the DKG
+stall?". This module records the PROTOCOL-level events those questions
+need, the way reference-network operators watch per-node partial
+arrival to predict threshold loss before it becomes a missed round:
+
+- every partial-signature event: sender share index, ingress source
+  (``grpc`` handler vs ``gossip`` hop vs our own ``self`` broadcast),
+  monotonic offset from the round's scheduled boundary, and the
+  verify/dedup verdict;
+- every aggregation milestone: the **quorum time** (arrival of the
+  t-th valid partial — the moment the round became recoverable),
+  recovery dispatch, store;
+- the DKG/reshare path: phase transitions, deal/response/justification
+  bundles seen per issuer, QUAL evolution — so a wedged DKG is
+  diagnosable from ``/debug/flight/dkg`` instead of log archaeology.
+
+Derived SLIs (metrics catalogue):
+
+- ``beacon_quorum_margin_seconds`` = period − time-to-t-th-partial:
+  the distance-to-missed-round early-warning signal. A healthy group
+  holds margin ≈ period; a dying one watches it shrink toward 0 for
+  rounds BEFORE ``beacon_rounds_missed_total`` ever fires.
+- ``beacon_partial_arrival_seconds{source}``: valid-arrival offset
+  from the boundary, split by ingress source.
+- ``beacon_partial_events_total{index,event}``: per-peer contribution
+  (``contributed``), lateness (``late`` = arrived more than period/2
+  after the boundary), and ``invalid`` counters.
+- ``beacon_contribution_gap``: group size minus distinct contributors
+  of the last stored round (0 = full participation).
+- ``dkg_phase_seconds{phase}``: DKG phase durations.
+
+Recording is OFF the hot path by construction: every ``note_*`` is a
+ring append under one lock — no pairing-class work, no I/O, no
+awaits (analyzer-clean from the ingest path; ``bench.py
+flight_overhead`` proves the cost on a 64-round follow). DoS posture:
+only VALID events may create a ring entry — rejected future/stale/
+invalid traffic appends to an existing round's record or is counted in
+the per-peer counters only, so a flood of garbage rounds cannot evict
+live flight records. Per-round event lists are bounded
+(``max_events``, overflow counted in ``dropped``).
+
+Secret hygiene: the recorder's API accepts indices, names, verdicts
+and clock readings ONLY — shares (``pri_share``), partial-signature
+bytes and keys never enter this module (asserted by
+tests/test_zz_flight.py against a real DKG's secrets).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+# contribution-bitmap encoding (README Observability): one char per
+# share index 0..n-1 of a round, rows = rounds in `drand util flight`
+BITMAP_ONTIME = "#"    # valid partial within period/2 of the boundary
+BITMAP_LATE = "~"      # valid but later than period/2
+BITMAP_INVALID = "!"   # invalid partial(s) seen, no valid one
+BITMAP_MISSING = "."   # nothing seen from this index
+
+# verdicts recorded for partial events; "valid" is the only one that
+# may CREATE a ring entry (see module docstring DoS posture)
+VALID = "valid"
+_PEER_EVENTS = ("contributed", "late", "invalid")
+
+
+def _arrival_hist(source: str):
+    """Branch-literal label values (check_metrics lints the enum from
+    the literal call sites — same rule as crypto/batch._timed paths);
+    unknown sources collapse to "grpc" rather than forking the series."""
+    from .. import metrics
+
+    if source == "gossip":
+        return metrics.PARTIAL_ARRIVAL.labels(source="gossip")
+    if source == "self":
+        return metrics.PARTIAL_ARRIVAL.labels(source="self")
+    return metrics.PARTIAL_ARRIVAL.labels(source="grpc")
+
+
+def _phase_hist(phase: str):
+    """Branch-literal DKG phase labels (see _arrival_hist)."""
+    from .. import metrics
+
+    if phase == "deal":
+        return metrics.DKG_PHASE_SECONDS.labels(phase="deal")
+    if phase == "response":
+        return metrics.DKG_PHASE_SECONDS.labels(phase="response")
+    if phase == "justification":
+        return metrics.DKG_PHASE_SECONDS.labels(phase="justification")
+    return metrics.DKG_PHASE_SECONDS.labels(phase="finish")
+
+
+class FlightRecorder:
+    """Bounded per-round ring of partial-arrival events + aggregation
+    milestones, plus cumulative per-peer counters.
+
+    ``max_rounds`` bounds retained rounds (FIFO eviction);
+    ``max_events`` bounds each round's event list (a partial flood must
+    not grow memory — overflow is counted in ``dropped``)."""
+
+    def __init__(self, max_rounds: int = 128, max_events: int = 256):
+        self.max_rounds = max_rounds
+        self.max_events = max_events
+        self._lock = threading.Lock()
+        # round -> {"round","boundary","period","n","threshold",
+        #           "quorum_offset_s","margin_s","events":[...],
+        #           "milestones":[...],"dropped":int}
+        self._rounds: OrderedDict[int, dict] = OrderedDict()
+        # share index -> {"contributed","late","invalid"} totals
+        self._peers: dict[int, dict] = {}
+        self.dkg = DKGFlight()
+
+    # ------------------------------------------------------------ helpers
+    @staticmethod
+    def _offset(now: float, round_no: int, period: int, genesis: int) -> float:
+        from ..chain import time_math
+
+        return now - time_math.time_of_round(period, genesis, round_no)
+
+    def _peer(self, index: int) -> dict:
+        st = self._peers.get(index)
+        if st is None:
+            st = self._peers[index] = dict.fromkeys(_PEER_EVENTS, 0)
+        return st
+
+    def _get(self, round_no: int, create: bool, *, now: float, period: int,
+             genesis: int, n: int | None = None,
+             threshold: int | None = None) -> dict | None:
+        rec = self._rounds.get(round_no)
+        if rec is None:
+            if not create:
+                return None
+            from ..chain import time_math
+
+            rec = {"round": round_no,
+                   "boundary": time_math.time_of_round(period, genesis,
+                                                       round_no),
+                   "period": period, "n": n, "threshold": threshold,
+                   "quorum_offset_s": None, "margin_s": None,
+                   # share index -> earliest valid arrival offset. The
+                   # authority for dedup, bitmap and the contribution
+                   # gap — NOT the capped event list, which an invalid
+                   # flood can fill before an honest partial lands
+                   "contrib": {}, "events": [], "milestones": [],
+                   "dropped": 0}
+            self._rounds[round_no] = rec
+            while len(self._rounds) > self.max_rounds:
+                self._rounds.popitem(last=False)
+        else:
+            if n is not None:
+                rec["n"] = n
+            if threshold is not None:
+                rec["threshold"] = threshold
+        return rec
+
+    @staticmethod
+    def _append(rec: dict, kind: str, item: dict, cap: int) -> None:
+        if len(rec[kind]) >= cap:
+            rec["dropped"] += 1
+            return
+        rec[kind].append(item)
+
+    # ------------------------------------------------------------- inputs
+    def note_partial(self, round_no: int, *, index: int | None, source: str,
+                     verdict: str, now: float, period: int, genesis: int,
+                     n: int | None = None, threshold: int | None = None,
+                     sender: str | None = None) -> None:
+        """One partial-signature ingress event. ``source`` is the enum
+        {grpc, gossip, self}; ``verdict`` is ``valid`` or the rejection
+        reason (invalid/stale/future/mismatch/duplicate). ``sender`` is
+        a display tag only (hashed for gossip) — never a raw secret."""
+        from .. import metrics
+
+        offset = self._offset(now, round_no, period, genesis)
+        valid = verdict == VALID
+        late = valid and offset > period / 2
+        # the index prefix is attacker-controlled bytes until the
+        # signature verified, and even an "invalid" verdict's index is
+        # only a claim — attribute to a peer (and mint a Prometheus
+        # `index` label) ONLY for indices the group can actually hold,
+        # so 2^16 garbage prefixes cannot bloat the peers table or the
+        # beacon_partial_events_total cardinality
+        attributable = (index is not None
+                        and (n is None or 0 <= index < n))
+        ev = {"t": now, "offset_s": round(offset, 6), "index": index,
+              "source": source, "verdict": verdict}
+        if sender is not None:
+            ev["sender"] = sender
+        with self._lock:
+            rec = self._get(round_no, create=valid, now=now, period=period,
+                            genesis=genesis, n=n, threshold=threshold)
+            if valid and index is not None and rec is not None:
+                if index in rec["contrib"]:
+                    # a replayed/re-flooded copy of an already-recorded
+                    # valid partial: visible in the event list, but it
+                    # must not re-count the peer's contribution,
+                    # re-feed the arrival histogram, or burn the
+                    # counters a replay flood would otherwise inflate
+                    valid = late = False
+                    ev["verdict"] = verdict = "duplicate"
+                else:
+                    rec["contrib"][index] = ev["offset_s"]
+            if rec is not None:
+                self._append(rec, "events", ev, self.max_events)
+            # per-peer attribution: contributions are signature-backed;
+            # "invalid" counts only verification failures (window
+            # rejects like stale/future stay visible in the round's
+            # event list but never frame a peer's counters)
+            if attributable:
+                if valid:
+                    st = self._peer(index)
+                    st["contributed"] += 1
+                    if late:
+                        st["late"] += 1
+                elif verdict == "invalid":
+                    self._peer(index)["invalid"] += 1
+        if valid:
+            _arrival_hist(source).observe(max(0.0, offset))
+        if attributable:
+            if valid:
+                metrics.PARTIAL_EVENTS.labels(event="contributed",
+                                              index=str(index)).inc()
+                if late:
+                    metrics.PARTIAL_EVENTS.labels(event="late",
+                                                  index=str(index)).inc()
+            elif verdict == "invalid":
+                metrics.PARTIAL_EVENTS.labels(event="invalid",
+                                              index=str(index)).inc()
+
+    def note_quorum(self, round_no: int, *, have: int, threshold: int,
+                    now: float, period: int, genesis: int,
+                    n: int | None = None) -> bool:
+        """The t-th valid partial is in: the round became recoverable.
+        Records the quorum time once per round and observes the
+        quorum-margin SLI (period minus time-to-quorum — negative when
+        quorum arrived after the round's whole period had passed).
+        Returns True only on the FIRST quorum of the round, so callers
+        can gate follow-up milestones on the same dedup."""
+        from .. import metrics
+
+        offset = self._offset(now, round_no, period, genesis)
+        with self._lock:
+            rec = self._get(round_no, create=True, now=now, period=period,
+                            genesis=genesis, n=n, threshold=threshold)
+            if rec["quorum_offset_s"] is not None:
+                return False  # first quorum wins; never re-timed
+            rec["quorum_offset_s"] = round(offset, 6)
+            rec["margin_s"] = round(period - offset, 6)
+            self._append(rec, "milestones",
+                         {"name": "quorum", "t": now,
+                          "offset_s": round(offset, 6), "have": have},
+                         self.max_events)
+        metrics.QUORUM_MARGIN.observe(period - offset)
+        return True
+
+    def note_milestone(self, round_no: int, name: str, *, now: float,
+                       period: int, genesis: int) -> None:
+        """An aggregation milestone (``recover`` dispatch, ``store``).
+        On ``store`` the contribution-gap gauge is refreshed: group
+        size minus distinct valid contributors of this round."""
+        from .. import metrics
+
+        offset = self._offset(now, round_no, period, genesis)
+        gap = None
+        with self._lock:
+            rec = self._get(round_no, create=False, now=now, period=period,
+                            genesis=genesis)
+            if rec is None:
+                return
+            self._append(rec, "milestones",
+                         {"name": name, "t": now,
+                          "offset_s": round(offset, 6)}, self.max_events)
+            if name == "store" and rec["n"]:
+                gap = max(0, rec["n"] - len(rec["contrib"]))
+        if gap is not None:
+            metrics.CONTRIBUTION_GAP.set(gap)
+
+    # ------------------------------------------------------------ outputs
+    @staticmethod
+    def _bitmap(rec: dict) -> str:
+        """One char per share index (BITMAP_* encoding); '' when the
+        group size was never learned for this round. Valid marks come
+        from the contrib map (exact even when an event flood filled
+        the capped list); invalid-only marks scan the event list —
+        under a flood the invalid events ARE the flood."""
+        n = rec.get("n")
+        if not n:
+            return ""
+        half = rec["period"] / 2
+        contrib = rec["contrib"]
+        out = []
+        for idx in range(n):
+            if idx in contrib:
+                out.append(BITMAP_LATE if contrib[idx] > half
+                           else BITMAP_ONTIME)
+            elif any(ev["index"] == idx and ev["verdict"] == "invalid"
+                     for ev in rec["events"]):
+                out.append(BITMAP_INVALID)
+            else:
+                out.append(BITMAP_MISSING)
+        return "".join(out)
+
+    def rounds(self, n: int = 16) -> list[dict]:
+        """The last ``n`` round flight records, most recent first, each
+        with its contribution bitmap rendered."""
+        with self._lock:
+            recs = list(self._rounds.values())[-n:] if n > 0 else []
+            out = []
+            for rec in reversed(recs):
+                c = dict(rec)
+                c["events"] = list(rec["events"])
+                c["milestones"] = list(rec["milestones"])
+                c["contrib"] = {str(i): off
+                                for i, off in rec["contrib"].items()}
+                c["bitmap"] = self._bitmap(rec)
+                out.append(c)
+        return out
+
+    def peers(self) -> dict[str, dict]:
+        """Cumulative per-share-index counters (JSON-keyed)."""
+        with self._lock:
+            return {str(i): dict(st)
+                    for i, st in sorted(self._peers.items())}
+
+    def reset(self) -> None:
+        """Back to boot state (tests). Same lock discipline as
+        Tracer.reset: a concurrent note_* either lands before the clear
+        or re-creates a fresh record after it — never a KeyError."""
+        with self._lock:
+            self._rounds.clear()
+            self._peers.clear()
+        self.dkg.reset()
+
+
+class DKGFlight:
+    """Bounded ring of DKG/reshare session timelines.
+
+    One session per protocol run, keyed by the session nonce; offsets
+    are seconds since the session's ``begin`` on the protocol's own
+    (injectable) clock, so FakeClock tests read exact phase math."""
+
+    def __init__(self, max_sessions: int = 16, max_marks: int = 512):
+        self.max_sessions = max_sessions
+        self.max_marks = max_marks
+        self._lock = threading.Lock()
+        self._sessions: OrderedDict[str, dict] = OrderedDict()
+
+    @staticmethod
+    def session_id(nonce: bytes, tag: int | str | None = None) -> str:
+        """Session key: nonce prefix, plus the node's own index — a
+        production process runs one node, but in-process multi-node
+        harnesses share the singleton and every node sees the SAME
+        nonce (their timelines must not interleave)."""
+        sid = nonce.hex()[:16]
+        return sid if tag is None else f"{sid}/{tag}"
+
+    def begin(self, nonce: bytes, *, mode: str, n_dealers: int,
+              n_receivers: int, threshold: int, now: float,
+              tag: int | str | None = None) -> str:
+        sid = self.session_id(nonce, tag)
+        with self._lock:
+            self._sessions[sid] = {
+                "session": sid, "mode": mode, "start": now,
+                "n_dealers": n_dealers, "n_receivers": n_receivers,
+                "threshold": threshold,
+                "phases": [], "bundles": {"deal": {}, "response": {},
+                                          "justification": {}},
+                "qual": None, "complaints": {}, "error": None,
+                "done": False, "dropped": 0}
+            while len(self._sessions) > self.max_sessions:
+                self._sessions.popitem(last=False)
+        return sid
+
+    def _rec(self, sid: str) -> dict | None:
+        return self._sessions.get(sid)
+
+    def note_phase(self, sid: str, phase: str, *, now: float) -> None:
+        """A phase transition: closes the open phase (observing
+        ``dkg_phase_seconds{phase}``) and opens ``phase``."""
+        from .. import metrics
+
+        dur = None
+        prev = None
+        with self._lock:
+            rec = self._rec(sid)
+            if rec is None:
+                return
+            off = now - rec["start"]
+            if rec["phases"] and rec["phases"][-1].get("end_s") is None:
+                prev = rec["phases"][-1]
+                prev["end_s"] = round(off, 6)
+                dur = prev["end_s"] - prev["start_s"]
+            rec["phases"].append({"phase": phase,
+                                  "start_s": round(off, 6), "end_s": None})
+        if prev is not None and dur is not None:
+            _phase_hist(prev["phase"]).observe(max(0.0, dur))
+
+    def note_bundle(self, sid: str, kind: str, issuer: int, *,
+                    now: float) -> None:
+        """A deal/response/justification bundle was accepted from
+        ``issuer`` (first arrival per issuer wins)."""
+        with self._lock:
+            rec = self._rec(sid)
+            if rec is None:
+                return
+            seen = rec["bundles"].setdefault(kind, {})
+            if str(issuer) in seen:
+                return
+            if sum(len(v) for v in rec["bundles"].values()) >= self.max_marks:
+                rec["dropped"] += 1
+                return
+            seen[str(issuer)] = round(now - rec["start"], 6)
+
+    def finish(self, sid: str, *, now: float, qual: list[int] | None = None,
+               complaints: dict | None = None,
+               error: str | None = None) -> None:
+        """Close the session: QUAL (or the failure), open-complaint map
+        {dealer: [share idxs]}, and the final phase's duration."""
+        closed = None
+        with self._lock:
+            rec = self._rec(sid)
+            if rec is None:
+                return
+            off = now - rec["start"]
+            if rec["phases"] and rec["phases"][-1].get("end_s") is None:
+                closed = rec["phases"][-1]
+                closed["end_s"] = round(off, 6)
+            rec["qual"] = list(qual) if qual is not None else None
+            rec["complaints"] = {str(k): sorted(v) for k, v in
+                                 (complaints or {}).items() if v}
+            rec["error"] = error
+            rec["done"] = True
+        if closed is not None:
+            _phase_hist(closed["phase"]).observe(
+                max(0.0, closed["end_s"] - closed["start_s"]))
+
+    def sessions(self) -> list[dict]:
+        """All retained sessions, most recent first (deep-ish copies)."""
+        with self._lock:
+            out = []
+            for rec in reversed(self._sessions.values()):
+                c = dict(rec)
+                c["phases"] = [dict(p) for p in rec["phases"]]
+                c["bundles"] = {k: dict(v)
+                                for k, v in rec["bundles"].items()}
+                c["complaints"] = dict(rec["complaints"])
+                out.append(c)
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._sessions.clear()
+
+
+# The per-process recorder every instrumentation site shares (the ring
+# is per-process by design, like TRACER and HEALTH).
+FLIGHT = FlightRecorder()

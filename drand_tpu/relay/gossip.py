@@ -48,6 +48,7 @@ from ..chain.beacon import Beacon
 from ..chain.info import Info
 from ..client.interface import Client, ClientError, result_from_beacon
 from ..net import wire
+from ..obs import flight as obs_flight
 from ..obs import trace as obs_trace
 from ..utils.aio import spawn
 from ..utils.clock import Clock, SystemClock
@@ -344,6 +345,16 @@ class GossipNode(Client):
                 # streams and /healthz
                 ok = await asyncio.to_thread(self._validate, msg, max_live)
                 sp.attrs["ok"] = ok
+            # the gossip hop's flight event: arrival offset + verdict
+            # under source="gossip" (same hashed sender tag as the
+            # span — mesh neighbours are not public topology). A ring
+            # append under one lock, back on the loop after the
+            # to_thread verification.
+            obs_flight.FLIGHT.note_partial(
+                msg.round, index=None, source="gossip",
+                verdict="valid" if ok else "invalid",
+                now=self._clock.now(), period=self.chain_info.period,
+                genesis=self.chain_info.genesis_time, sender=sender_tag)
         else:
             ok = True
         if not ok:

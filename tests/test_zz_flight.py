@@ -1,0 +1,518 @@
+"""Threshold flight recorder (ISSUE 10): quorum-margin math,
+contribution bitmaps, DKG phase timelines, the /debug/flight surface
+and the recorder's bounds/hygiene.
+
+Late-alphabet filename per the tier-1 chunking convention (ROADMAP
+operational constraint). Everything here is host-only crypto — no
+device graphs, no fresh XLA compiles.
+"""
+
+import asyncio
+import json
+import threading
+
+import aiohttp
+import pytest
+from aiohttp import web
+from conftest import sample_count as _sample_count
+
+from drand_tpu import metrics
+from drand_tpu.dkg import DKGConfig, DKGProtocol, LocalBoard
+from drand_tpu.http_server.debug import add_trace_routes
+from drand_tpu.obs.flight import FLIGHT, FlightRecorder
+from drand_tpu.testing.harness import BeaconTestNetwork
+from drand_tpu.utils.clock import FakeClock
+
+PERIOD, GENESIS = 10, 1000
+
+
+def _boundary(rnd):
+    return GENESIS + (rnd - 1) * PERIOD
+
+
+def _feed(f, rnd, index, offset, verdict="valid", source="grpc",
+          n=5, t=3):
+    f.note_partial(rnd, index=index, source=source, verdict=verdict,
+                   now=_boundary(rnd) + offset, period=PERIOD,
+                   genesis=GENESIS, n=n, threshold=t)
+
+
+# ---------------------------------------------------------------------------
+# quorum-margin math against a scripted partial schedule
+# ---------------------------------------------------------------------------
+
+def test_quorum_margin_scripted_schedule():
+    """t=3-of-5, partials at +1.0/+2.5/+4.0/+7.0: quorum is the THIRD
+    valid arrival (+4.0), margin = period - 4.0 = 6.0; the late peer
+    (+7.0 > period/2) is flagged late but does not move the quorum."""
+    f = FlightRecorder()
+    q0 = _sample_count(metrics.GROUP_REGISTRY,
+                       "beacon_quorum_margin_seconds")
+    for idx, off in ((0, 1.0), (1, 2.5), (4, 4.0)):
+        _feed(f, 7, idx, off)
+    f.note_quorum(7, have=3, threshold=3, now=_boundary(7) + 4.0,
+                  period=PERIOD, genesis=GENESIS, n=5)
+    _feed(f, 7, 2, 7.0)  # straggler, after quorum
+    rec = f.rounds(1)[0]
+    assert rec["round"] == 7
+    assert rec["quorum_offset_s"] == pytest.approx(4.0)
+    assert rec["margin_s"] == pytest.approx(PERIOD - 4.0)
+    # first quorum wins: a re-aggregation attempt never re-times
+    f.note_quorum(7, have=4, threshold=3, now=_boundary(7) + 9.0,
+                  period=PERIOD, genesis=GENESIS)
+    assert f.rounds(1)[0]["margin_s"] == pytest.approx(6.0)
+    assert _sample_count(metrics.GROUP_REGISTRY,
+                         "beacon_quorum_margin_seconds") == q0 + 1
+    # per-peer lateness: only the +7.0 arrival crossed period/2
+    assert f.peers()["2"]["late"] == 1
+    assert f.peers()["0"]["late"] == 0
+
+    # a dying group: quorum after the whole period -> NEGATIVE margin.
+    # note_quorum returns True only on the FIRST quorum (the recover
+    # milestone gate in chain_store rides this).
+    _feed(f, 8, 0, 11.0)
+    _feed(f, 8, 1, 11.5)
+    _feed(f, 8, 2, 12.0)
+    assert f.note_quorum(8, have=3, threshold=3, now=_boundary(8) + 12.0,
+                         period=PERIOD, genesis=GENESIS, n=5) is True
+    assert f.note_quorum(8, have=4, threshold=3, now=_boundary(8) + 13.0,
+                         period=PERIOD, genesis=GENESIS) is False
+    assert f.rounds(1)[0]["margin_s"] == pytest.approx(-2.0)
+
+
+def test_valid_replay_deduped_per_round_and_index():
+    """A replayed copy of an already-recorded valid partial records as
+    'duplicate': the peer's contributed counter, the arrival histogram
+    and the lateness flag never re-count (replays must not own the
+    per-peer rates)."""
+    f = FlightRecorder()
+    a0 = _sample_count(metrics.GROUP_REGISTRY,
+                       "beacon_partial_arrival_seconds", source="grpc")
+    _feed(f, 5, 1, 1.0)
+    _feed(f, 5, 1, 7.0)  # replay, late offset — must not count as late
+    rec = f.rounds(1)[0]
+    assert [ev["verdict"] for ev in rec["events"]] == ["valid",
+                                                       "duplicate"]
+    assert f.peers()["1"] == {"contributed": 1, "late": 0, "invalid": 0}
+    assert _sample_count(metrics.GROUP_REGISTRY,
+                         "beacon_partial_arrival_seconds",
+                         source="grpc") == a0 + 1
+    assert rec["bitmap"][1] == "#"
+
+    # the dedup/bitmap authority survives an event-list flood: a
+    # byzantine member fills the capped list with invalids BEFORE an
+    # honest partial lands — the honest contribution still counts
+    # exactly once (replays stay duplicates) and the bitmap still
+    # shows it, even though its event was dropped
+    f2 = FlightRecorder(max_events=8)
+    _feed(f2, 9, 0, 0.5)
+    for _ in range(10):
+        _feed(f2, 9, 4, 0.6, verdict="invalid")
+    _feed(f2, 9, 1, 1.0)         # honest, lands past the cap
+    _feed(f2, 9, 1, 1.5)         # replay of it
+    rec = f2.rounds(1)[0]
+    assert rec["dropped"] > 0 and len(rec["events"]) == 8
+    assert rec["contrib"] == {"0": 0.5, "1": 1.0}
+    assert rec["bitmap"] == "##..!"
+    assert f2.peers()["1"] == {"contributed": 1, "late": 0, "invalid": 0}
+
+
+# ---------------------------------------------------------------------------
+# contribution bitmap: dead + byzantine node
+# ---------------------------------------------------------------------------
+
+def test_contribution_bitmap_dead_and_byzantine():
+    """5 nodes: 0/1 on time, 2 late, 3 dead (nothing), 4 byzantine
+    (only invalid partials) -> bitmap '##~.!'; the store milestone sets
+    the contribution gap to 2 (dead + byzantine)."""
+    f = FlightRecorder()
+    _feed(f, 3, 0, 0.5)
+    _feed(f, 3, 1, 1.0)
+    _feed(f, 3, 2, 6.0)            # late: > period/2
+    _feed(f, 3, 4, 1.2, verdict="invalid")
+    rec = f.rounds(1)[0]
+    assert rec["bitmap"] == "##~.!"
+    f.note_milestone(3, "store", now=_boundary(3) + 7.0, period=PERIOD,
+                     genesis=GENESIS)
+    assert metrics.CONTRIBUTION_GAP._value.get() == 2
+    assert [m["name"] for m in f.rounds(1)[0]["milestones"]] == ["store"]
+    # peer counters: invalid attributed to 4, contributions to 0/1/2
+    peers = f.peers()
+    assert peers["4"] == {"contributed": 0, "late": 0, "invalid": 1}
+    assert peers["2"] == {"contributed": 1, "late": 1, "invalid": 0}
+
+
+def test_rejects_never_create_ring_entries():
+    """DoS posture: stale/future/invalid events for rounds the recorder
+    has never seen valid traffic for must NOT create ring entries (a
+    garbage flood across round numbers cannot evict live records), and
+    window rejects never frame a peer's invalid counter."""
+    f = FlightRecorder(max_rounds=4)
+    for rnd in range(100, 140):
+        _feed(f, rnd, 1, 0.1, verdict="future")
+        _feed(f, rnd, 1, 0.1, verdict="stale")
+    assert f.rounds(10) == []
+    assert f.peers().get("1", {}).get("invalid", 0) == 0
+    # invalid DOES count against the claimed index, but still creates
+    # no ring entry on its own
+    _feed(f, 200, 2, 0.1, verdict="invalid")
+    assert f.rounds(10) == []
+    assert f.peers()["2"]["invalid"] == 1
+    # an index the group cannot hold is never attributed: 2^16 garbage
+    # prefixes must not bloat the peers table or the metric cardinality
+    _feed(f, 200, 999, 0.1, verdict="invalid")
+    _feed(f, 200, -3, 0.1, verdict="invalid")
+    assert "999" not in f.peers() and "-3" not in f.peers()
+    # ...and appends to a round that EXISTS (valid traffic seen)
+    _feed(f, 300, 0, 0.2)
+    _feed(f, 300, 2, 0.3, verdict="invalid")
+    assert len(f.rounds(1)[0]["events"]) == 2
+
+
+def test_ring_and_event_bounds_and_reset_hammer():
+    """max_rounds FIFO eviction, max_events overflow -> dropped, and
+    reset() racing concurrent note_* without KeyError/corruption."""
+    f = FlightRecorder(max_rounds=8, max_events=16)
+    for rnd in range(1, 30):
+        _feed(f, rnd, 0, 0.1)
+    recs = f.rounds(100)
+    assert len(recs) == 8
+    assert recs[0]["round"] == 29 and recs[-1]["round"] == 22
+    for i in range(40):
+        _feed(f, 29, i % 5, 0.2)
+    top = f.rounds(1)[0]
+    assert len(top["events"]) == 16
+    assert top["dropped"] > 0
+
+    stop = threading.Event()
+    errors = []
+
+    def hammer():
+        rnd = 0
+        while not stop.is_set():
+            rnd += 1
+            try:
+                _feed(f, rnd % 50, rnd % 5, 0.1)
+                f.note_quorum(rnd % 50, have=3, threshold=3,
+                              now=_boundary(rnd % 50) + 1, period=PERIOD,
+                              genesis=GENESIS)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for _ in range(200):
+        f.reset()
+        f.rounds(8)
+        f.peers()
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors
+
+
+# ---------------------------------------------------------------------------
+# DKG phase timeline on the 5-node fixture (one crashed dealer)
+# ---------------------------------------------------------------------------
+
+def _make_dkg_nodes(n):
+    from drand_tpu.key.keys import Node, new_key_pair
+
+    pairs = [new_key_pair(f"flight-dkg-{i}.test:9{i:03d}",
+                          seed=b"flight-dkg%d" % i) for i in range(n)]
+    nodes = [Node(identity=p.public, index=i) for i, p in enumerate(pairs)]
+    return pairs, nodes
+
+
+@pytest.mark.asyncio
+async def test_dkg_phase_timeline_with_crashed_dealer():
+    """The 5-node DKG fixture with node 4 never running: the flight
+    timeline shows deal-phase arrivals from exactly dealers 0-3, a
+    deal phase that lasted the full 10 s timeout (the crash is VISIBLE
+    as the stall), QUAL [0,1,2,3], and dkg_phase_seconds samples."""
+    FLIGHT.dkg.reset()
+    n, t = 5, 3
+    pairs, nodes = _make_dkg_nodes(n)
+    clock = FakeClock()
+    t0 = clock.now()
+    boards = LocalBoard.make_group(n)
+    configs = [DKGConfig(longterm=pairs[i], nonce=b"flight-nonce",
+                         new_nodes=nodes, threshold=t, clock=clock,
+                         phase_timeout=10, seed=b"flight-crashed")
+               for i in range(n - 1)]
+    d0 = _sample_count(metrics.GROUP_REGISTRY, "dkg_phase_seconds",
+                       phase="deal")
+
+    async def drive_clock():
+        for _ in range(8):
+            await clock.advance(10)
+
+    results_task = asyncio.gather(*(DKGProtocol(c, b).run()
+                                    for c, b in zip(configs, boards[:n - 1])))
+    await asyncio.gather(results_task, drive_clock())
+    results = results_task.result()
+    sessions = FLIGHT.dkg.sessions()
+    assert len(sessions) == n - 1
+    for s in sessions:
+        assert s["done"] and s["error"] is None
+        assert s["mode"] == "dkg"
+        assert s["qual"] == [0, 1, 2, 3]
+        assert s["n_dealers"] == n and s["threshold"] == t
+        # dealers 0-3 dealt; the crashed dealer 4 is ABSENT
+        assert sorted(s["bundles"]["deal"]) == ["0", "1", "2", "3"]
+        assert sorted(s["bundles"]["response"]) == ["0", "1", "2", "3"]
+        # every live receiver complained about the silent dealer, so a
+        # justification phase ran — and dealer 4 never justified
+        assert s["bundles"]["justification"] == {}
+        assert s["complaints"] == {"4": [0, 1, 2, 3]}
+        phases = [p["phase"] for p in s["phases"]]
+        assert phases == ["deal", "response", "justification", "finish"]
+        deal = s["phases"][0]
+        # fast-sync could not fire (4 of 5 expected): the deal phase
+        # ran its whole 10 s phaser window on the fake clock
+        assert deal["end_s"] - deal["start_s"] == pytest.approx(10.0)
+        for p in s["phases"]:
+            assert p["end_s"] is not None
+    assert _sample_count(metrics.GROUP_REGISTRY, "dkg_phase_seconds",
+                         phase="deal") >= d0 + (n - 1)
+
+    # secret hygiene: the recorder state never saw any node's share.
+    # Partials are public; shares are NOT — serialize everything the
+    # recorder retains and assert no pri_share value (decimal or hex)
+    # appears, nor the field name itself.
+    blob = json.dumps({"rounds": FLIGHT.rounds(FLIGHT.max_rounds),
+                       "peers": FLIGHT.peers(),
+                       "dkg": FLIGHT.dkg.sessions()})
+    assert "pri_share" not in blob
+    for r in results:
+        if r.pri_share is None:
+            continue
+        assert str(r.pri_share.value) not in blob
+        assert format(r.pri_share.value, "x") not in blob
+
+
+# ---------------------------------------------------------------------------
+# live network: per-partial telemetry + dead-peer degradation
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_network_flight_records_and_dead_peer_degrades():
+    """A 3-node t=2 network produces rounds with full '###' bitmaps and
+    positive quorum margins; killing node 2 degrades the bitmap to
+    '##.' and sets the contribution gap — all while rounds still
+    aggregate (the early-warning half of the acceptance demo)."""
+    FLIGHT.reset()
+    net = BeaconTestNetwork(n=3, t=2, period=5)
+    await net.start_all()
+    await net.advance_to_genesis()
+    for r in range(1, 3):
+        await net.clock.advance(net.group.period)
+        for i in range(3):
+            await net.wait_round(i, r)
+    healthy = {rec["round"]: rec for rec in FLIGHT.rounds(16)}
+    assert healthy, "no flight records after live rounds"
+    # only the rounds we waited for — the NEXT round's partials may
+    # already be recorded while its aggregation is still in flight
+    full = [rec for rec in healthy.values()
+            if rec["bitmap"] == "###" and rec["round"] <= 2]
+    assert full, f"no full-participation bitmap: {healthy}"
+    for rec in full:
+        assert rec["margin_s"] is not None and rec["margin_s"] > 0
+        names = [m["name"] for m in rec["milestones"]]
+        assert names[0] == "quorum"
+        assert "recover" in names and "store" in names
+        sources = {ev["source"] for ev in rec["events"]}
+        assert "self" in sources and "grpc" in sources
+
+    # ---- kill node 2: quorum survives (t=2), its column goes dark ----
+    # anchor past the highest round the recorder has already seen —
+    # the next round's partials (node 2's included) may be in flight
+    seen = max(rec["round"] for rec in FLIGHT.rounds(16))
+    net.nodes[2].handler.stop()
+    for r in range(seen + 1, seen + 3):
+        await net.clock.advance(net.group.period)
+        for i in range(2):
+            await net.wait_round(i, r)
+    degraded = [rec for rec in FLIGHT.rounds(16)
+                if seen < rec["round"] <= seen + 2 and rec["bitmap"]]
+    assert degraded
+    for rec in degraded:
+        assert rec["bitmap"].endswith("."), rec["bitmap"]
+        assert rec["margin_s"] is not None
+    assert metrics.CONTRIBUTION_GAP._value.get() == 1
+    # arrivals landed under both ingress sources, none under gossip
+    assert _sample_count(metrics.GROUP_REGISTRY,
+                         "beacon_partial_arrival_seconds",
+                         source="self") > 0
+    assert _sample_count(metrics.GROUP_REGISTRY,
+                         "beacon_partial_arrival_seconds",
+                         source="grpc") > 0
+    net.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# /debug/flight routes + util flight rendering
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_debug_flight_routes_and_cli_rendering(capsys):
+    FLIGHT.reset()
+    _feed(FLIGHT, 41, 0, 0.5)
+    _feed(FLIGHT, 41, 1, 6.0)
+    _feed(FLIGHT, 41, 3, 0.7, verdict="invalid")
+    FLIGHT.note_quorum(41, have=2, threshold=2, now=_boundary(41) + 6.0,
+                       period=PERIOD, genesis=GENESIS, n=4)
+    sid = FLIGHT.dkg.begin(b"route-nonce", mode="dkg", n_dealers=3,
+                           n_receivers=3, threshold=2, now=100.0)
+    FLIGHT.dkg.note_phase(sid, "deal", now=100.0)
+    FLIGHT.dkg.note_bundle(sid, "deal", 0, now=100.5)
+    FLIGHT.dkg.note_phase(sid, "response", now=101.0)
+    FLIGHT.dkg.finish(sid, now=102.0, qual=[0, 1, 2])
+
+    app = web.Application()
+    add_trace_routes(app)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{port}/debug/flight/"
+                             f"rounds?n=4") as r:
+                assert r.status == 200
+                rounds_payload = await r.json()
+            async with s.get(f"http://127.0.0.1:{port}/debug/flight/"
+                             f"rounds?n=1e9") as r:
+                assert r.status == 400
+            async with s.get(f"http://127.0.0.1:{port}/debug/flight/"
+                             f"dkg") as r:
+                assert r.status == 200
+                dkg_payload = await r.json()
+    finally:
+        await runner.cleanup()
+
+    rec = rounds_payload["rounds"][0]
+    assert rec["round"] == 41 and rec["bitmap"] == "#~.!"
+    assert rounds_payload["peers"]["3"]["invalid"] == 1
+    ses = dkg_payload["sessions"][0]
+    assert ses["qual"] == [0, 1, 2]
+    assert [p["phase"] for p in ses["phases"]] == ["deal", "response"]
+    assert ses["phases"][1]["end_s"] == pytest.approx(2.0)
+
+    # the util flight renderers consume exactly these payloads
+    from drand_tpu.cli.__main__ import (_print_flight_dkg,
+                                        _print_flight_matrix)
+
+    _print_flight_matrix(rounds_payload)
+    out = capsys.readouterr().out
+    assert "# ~ . !" in out          # the matrix row for round 41
+    assert "41" in out and "2/2" in out
+    assert "invalid" in out          # peers table header
+    _print_flight_dkg(dkg_payload)
+    out = capsys.readouterr().out
+    assert "QUAL: [0, 1, 2]" in out
+    assert "deal" in out and "0@+0.500s" in out
+
+
+# ---------------------------------------------------------------------------
+# OTLP satellites: node resource attrs + spool shipping
+# ---------------------------------------------------------------------------
+
+def test_otlp_node_attrs_gated(monkeypatch):
+    """drand.node.address rides exported spans ONLY under
+    DRAND_TPU_OTLP_NODE_ATTRS=1 (privacy default-off)."""
+    from drand_tpu.obs import export as obs_export
+    from drand_tpu.obs import trace
+
+    obs_export.set_node_address("node-a.test:8001")
+    tr = trace.Tracer()
+    with tr.activate(round_no=5, chain=b"attr-chain"):
+        with tr.span("partial"):
+            pass
+    rec = tr.get_trace(trace.round_trace_id(5, b"attr-chain"))
+    exp = obs_export.OTLPExporter(spool_path="/dev/null")
+
+    monkeypatch.delenv("DRAND_TPU_OTLP_NODE_ATTRS", raising=False)
+    attrs = {a["key"] for a in
+             exp._payload(rec)["resourceSpans"][0]["resource"]["attributes"]}
+    assert "drand.node.address" not in attrs
+
+    monkeypatch.setenv("DRAND_TPU_OTLP_NODE_ATTRS", "1")
+    res = exp._payload(rec)["resourceSpans"][0]["resource"]["attributes"]
+    by_key = {a["key"]: a["value"] for a in res}
+    assert by_key["drand.node.address"]["stringValue"] == "node-a.test:8001"
+
+
+@pytest.mark.asyncio
+async def test_ship_spool_batches_retries_and_truncates(tmp_path):
+    """ship_spool re-POSTs the spooled ring in merged batches, retries
+    a transiently failing collector with backoff, truncates both ring
+    files on success, and leaves the spool intact on permanent
+    failure."""
+    from drand_tpu.obs import export as obs_export
+    from drand_tpu.obs import trace
+
+    spool = str(tmp_path / "ship.ndjson")
+    exp = obs_export.OTLPExporter(spool_path=spool)
+    tr = trace.Tracer()
+    for rnd in range(1, 8):
+        with tr.activate(round_no=rnd, chain=b"ship-chain"):
+            with tr.span("store", rnd=rnd):
+                pass
+        assert exp.export_round_sync(
+            tr.get_trace(trace.round_trace_id(rnd, b"ship-chain"))) == "spool"
+
+    # a daemon killed mid-append leaves a truncated line: the shipper
+    # (and any read_spool consumer) must skip it, not crash-loop
+    with open(spool, "a", encoding="utf-8") as fh:
+        fh.write('{"resourceSpans": [{"trunc')
+    assert len(obs_export.read_spool(spool)) == 7
+
+    posts, fail_first = [], [2]  # fail the first two POSTs
+
+    async def collector(request):
+        if fail_first[0] > 0:
+            fail_first[0] -= 1
+            return web.Response(status=503)
+        posts.append(await request.json())
+        return web.json_response({})
+
+    app = web.Application()
+    app.add_routes([web.post("/v1/traces", collector)])
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    try:
+        out = await obs_export.ship_spool(
+            spool, f"http://127.0.0.1:{port}", batch_size=3,
+            backoff=0.01)
+        assert out == {"shipped": 7, "batches": 3, "ok": True}
+        # batches merged resourceSpans; every spooled round arrived
+        spans = [sp for doc in posts for rs in doc["resourceSpans"]
+                 for ss in rs["scopeSpans"] for sp in ss["spans"]]
+        assert len(spans) == 7
+        # truncated on success; a re-ship is a no-op
+        assert obs_export.read_spool(spool) == []
+        out = await obs_export.ship_spool(spool,
+                                          f"http://127.0.0.1:{port}")
+        assert out == {"shipped": 0, "batches": 0, "ok": True}
+
+        # permanent failure keeps the spool for the next cycle
+        for rnd in range(20, 23):
+            with tr.activate(round_no=rnd, chain=b"ship-chain"):
+                with tr.span("store"):
+                    pass
+            exp.export_round_sync(
+                tr.get_trace(trace.round_trace_id(rnd, b"ship-chain")))
+        fail_first[0] = 10 ** 6
+        out = await obs_export.ship_spool(
+            spool, f"http://127.0.0.1:{port}", attempts=2, backoff=0.01)
+        assert out["ok"] is False
+        assert len(obs_export.read_spool(spool)) == 3
+    finally:
+        await runner.cleanup()

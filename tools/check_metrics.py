@@ -66,6 +66,17 @@ KNOWN_LABEL_VALUES = {
     "timelock_gt_cache_requests": {"result": {"hit", "miss"}},
     "timelock_ciphertexts_total": {"result": {"submitted", "opened",
                                               "rejected"}},
+    # threshold flight recorder (obs/flight.py, ISSUE 10). The `index`
+    # label of beacon_partial_events_total is the share index — dynamic
+    # but bounded by the group size, so only the `event` enum is pinned
+    # here (non-literal label kwargs are invisible to labels_used by
+    # design).
+    "beacon_partial_arrival_seconds": {"source": {"grpc", "gossip",
+                                                  "self"}},
+    "beacon_partial_events_total": {"event": {"contributed", "late",
+                                              "invalid"}},
+    "dkg_phase_seconds": {"phase": {"deal", "response", "justification",
+                                    "finish"}},
 }
 
 

@@ -28,6 +28,7 @@
 #   test_zz_analyze.py     static-analysis suite (host-only, <60 s,
 #                          no backend init — pure AST + one aiohttp
 #                          harness)
+#   test_zz_flight.py      threshold flight recorder suite (host-only)
 #   test_zz_obs_health.py  chain-health SLO / OTLP export suite
 #
 # Exit status: 0 iff every chunk passed.
