@@ -28,13 +28,15 @@ Environment knobs:
     BENCH_MIN_SECONDS  minimum timed window per trial (default 5.0)
     BENCH_TRIALS       trials per config (default 2; best wins)
     BENCH_CONFIGS      comma list to run: any of
-                       msm,glv4,rlc,obs,shard,e2e,catchup,recover,
-                       deal,replay,headline (default: all; msm, glv4,
-                       rlc, obs and shard are host-only and run FIRST,
+                       msm,glv4,rlc,obs,flight,chaos,timelock,shard,
+                       e2e,catchup,recover,deal,replay,headline
+                       (default: all; msm, glv4, rlc, obs, flight,
+                       chaos and timelock are host-only and run FIRST,
                        before backend init, so they report even with
                        the TPU tunnel down — shard re-execs onto the
                        virtual CPU mesh and is bounded by the remaining
                        budget)
+    BENCH_CHAOS_N      chaos_soak network size (default 32)
     DRAND_TPU_CONV     tree|kara|unroll — limb conv strategy (A/B)
     DRAND_TPU_LAZY     1|0 — lazy Fp2/6/12 reduction (A/B)
     DRAND_TPU_PAIRFOLD 1|0 — paired-line Miller fold (A/B)
@@ -553,6 +555,77 @@ def bench_flight_overhead(trials):
             "vs_baseline": None}
 
 
+def bench_chaos_soak(trials):
+    """Chaos soak (ISSUE 11): a 32-node t=17 in-process beacon network
+    on the FakeClock under a scripted fault schedule — healthy rounds,
+    then a cross-link delay fault (the margin early-warning window),
+    then a no-quorum partition (missed rounds), then heal. Reports the
+    observability stack's DETECTION LEAD TIME (first quorum-margin
+    warning -> first missed-round increment) and RECOVERY TIME (fault
+    heal -> head lag back to 0), both read off the same SLI surfaces
+    operators alert on. Structural crypto (testing/chaos.py): the
+    verdict/timing plumbing is what is being measured, not pairings —
+    pure host, runs FIRST before backend init, reports with the tunnel
+    down."""
+    import asyncio
+
+    from drand_tpu.obs.state import isolated_observability
+    from drand_tpu.testing.chaos import (ChaosBeaconNetwork, FaultEvent,
+                                         LinkPolicy, detection_lead,
+                                         recovery_seconds,
+                                         structural_crypto)
+
+    n = int(os.environ.get("BENCH_CHAOS_N", "32"))
+    t = n // 2 + 1
+    period = 4
+    healthy, degraded, dead = 3, 3, 3
+    fault_round = 2 + healthy          # first observed round is 2
+    partition_round = fault_round + degraded
+    heal_round = partition_round + dead
+    rounds = heal_round + 6
+
+    async def soak():
+        net = ChaosBeaconNetwork(n=n, t=t, period=period)
+        await net.start_all()
+        await net.advance_to_genesis()
+        half = list(range(n // 2))
+        rest = list(range(n // 2, n))
+        sched = [
+            FaultEvent(fault_round, "link_all",
+                       {"policy": LinkPolicy(delay_s=period * 0.6,
+                                             jitter_s=period * 0.1)}),
+            FaultEvent(partition_round, "partition",
+                       {"groups": [half, rest]}),
+            FaultEvent(heal_round, "heal"),
+        ]
+        try:
+            return await net.run_schedule(sched, rounds=rounds)
+        finally:
+            net.stop_all()
+
+    t0 = time.perf_counter()
+    with structural_crypto(), isolated_observability():
+        obs = asyncio.run(soak())
+    wall = time.perf_counter() - t0
+    lead = detection_lead(obs, period)
+    rec = recovery_seconds(obs, heal_round, period)
+    missed = max(ob.missed_total for ob in obs)
+    if lead["lead_rounds"] is None or rec is None:
+        raise RuntimeError(
+            f"chaos soak inconclusive: lead={lead} recovery={rec}")
+    return {"metric": "chaos_soak_detection_lead",
+            "value": float(lead["lead_seconds"]), "unit": "s",
+            "nodes": n, "threshold": t, "period_s": period,
+            "rounds": rounds,
+            "lead_rounds": lead["lead_rounds"],
+            "warn_round": lead["warn_round"],
+            "missed_round": lead["missed_round"],
+            "missed_rounds_total": missed,
+            "recovery_seconds": rec,
+            "wall_seconds": round(wall, 1),
+            "vs_baseline": None}
+
+
 def bench_msm_pippenger(trials):
     """Host MSM strategy A/B on a 64-point G2 span with 128-bit RLC
     scalars: the ψ-endomorphism-split Pippenger (crypto/batch_verify.msm
@@ -865,8 +938,8 @@ def main() -> None:
     t_start = time.perf_counter()
     which = os.environ.get(
         "BENCH_CONFIGS",
-        "msm,glv4,rlc,obs,flight,timelock,shard,e2e,catchup,recover,deal,"
-        "replay,headline").split(",")
+        "msm,glv4,rlc,obs,flight,chaos,timelock,shard,e2e,catchup,recover,"
+        "deal,replay,headline").split(",")
 
     # --- outage-proofing (round-3 lesson: the official record must never
     # be an unparseable traceback). Two layers:
@@ -975,6 +1048,18 @@ def main() -> None:
 
             log(traceback.format_exc())
             diag("aux_config_failed", config="flight",
+                 error=f"{type(e).__name__}: {e}")
+
+    if "chaos" in which:
+        log("== chaos soak: 32-node fault schedule, detection lead + "
+            "recovery (host-only) ==")
+        try:
+            emit(bench_chaos_soak(trials))
+        except Exception as e:  # noqa: BLE001 — best-effort aux config
+            import traceback
+
+            log(traceback.format_exc())
+            diag("aux_config_failed", config="chaos",
                  error=f"{type(e).__name__}: {e}")
 
     if "timelock" in which:

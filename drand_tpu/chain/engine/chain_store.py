@@ -44,10 +44,15 @@ class ChainStore(CallbackStore):
 
     def __init__(self, logger: KVLogger, conf, client: ProtocolClient,
                  crypto: CryptoStore, store: Store, ticker: Ticker):
-        base = DiscrepancyStore(AppendStore(store), conf.group, conf.clock)
+        base = DiscrepancyStore(AppendStore(store), conf.group, conf.clock,
+                                health=getattr(conf, "health", None))
         super().__init__(base)
         self._l = logger
         self._conf = conf
+        # per-node recorder override (BeaconConfig.flight) — the process
+        # singleton unless an in-process harness injected one per node
+        self._flight = (conf.flight if getattr(conf, "flight", None)
+                        is not None else FLIGHT)
         self._client = client
         self._crypto = crypto
         self._ticker = ticker
@@ -132,14 +137,15 @@ class ChainStore(CallbackStore):
         # milestone rides the same gate — straggler partials past the
         # threshold re-enter here while the first aggregation is still
         # on its worker thread and must not append duplicate milestones
-        if FLIGHT.note_quorum(rc.round, have=len(rc), threshold=thr,
-                              now=self._conf.clock.now(),
-                              period=self._conf.group.period,
-                              genesis=self._conf.group.genesis_time, n=n):
-            FLIGHT.note_milestone(rc.round, "recover",
-                                  now=self._conf.clock.now(),
-                                  period=self._conf.group.period,
-                                  genesis=self._conf.group.genesis_time)
+        if self._flight.note_quorum(
+                rc.round, have=len(rc), threshold=thr,
+                now=self._conf.clock.now(),
+                period=self._conf.group.period,
+                genesis=self._conf.group.genesis_time, n=n):
+            self._flight.note_milestone(
+                rc.round, "recover", now=self._conf.clock.now(),
+                period=self._conf.group.period,
+                genesis=self._conf.group.genesis_time)
         new_beacon = await self._aggregate(rc, thr, n)
         if new_beacon is None:
             return last
@@ -215,10 +221,10 @@ class ChainStore(CallbackStore):
         except StoreError as e:
             self._l.error("aggregator", "error_storing", err=str(e))
             return False
-        FLIGHT.note_milestone(new_beacon.round, "store",
-                              now=self._conf.clock.now(),
-                              period=self._conf.group.period,
-                              genesis=self._conf.group.genesis_time)
+        self._flight.note_milestone(
+            new_beacon.round, "store", now=self._conf.clock.now(),
+            period=self._conf.group.period,
+            genesis=self._conf.group.genesis_time)
         try:
             self.catchup_beacons.put_nowait(new_beacon)
         except asyncio.QueueFull:

@@ -16,7 +16,7 @@ from ...key.group import Group
 from ...key.keys import Node, Share
 from ...net.packets import PartialBeaconPacket, SyncRequest
 from ...net.transport import ProtocolClient, ProtocolService, TransportError
-from ...obs.flight import FLIGHT
+from ...obs.flight import FLIGHT, FlightRecorder
 from ...obs.trace import TRACER
 from ...utils.aio import spawn
 from ...utils.clock import Clock
@@ -38,6 +38,17 @@ class BeaconConfig:
     share: Share
     group: Group
     clock: Clock
+    # flight recorder override. Production keeps the per-process FLIGHT
+    # singleton (None); in-process multi-node harnesses (chaos simulator,
+    # e2e suites) inject one recorder PER NODE so a byzantine or crashed
+    # node's own notes cannot pollute an honest node's telemetry — each
+    # recorder then models exactly what that node's process would see.
+    flight: "FlightRecorder | None" = None
+    # same rule for the chain-health state (obs/health.HealthState):
+    # None = the per-process HEALTH singleton; per-node instances keep a
+    # minority-partition node's lag/missed view honest (the singleton's
+    # head is a monotonic max across every in-process node)
+    health: object | None = None
 
 
 def _verify_partial_packet(pub, p: PartialBeaconPacket) -> str | None:
@@ -69,6 +80,7 @@ class Handler(ProtocolService):
         self.conf = conf
         self.addr = conf.public.address()
         self._l = logger
+        self.flight = conf.flight if conf.flight is not None else FLIGHT
         self.crypto = CryptoStore(conf.group, conf.share)
         store.put(genesis_beacon(self.crypto.chain_info))
         self.ticker = Ticker(conf.clock, conf.group.period, conf.group.genesis_time)
@@ -157,10 +169,11 @@ class Handler(ProtocolService):
         except ValueError:
             idx = None
         g = self.conf.group
-        FLIGHT.note_partial(p.round, index=idx, source=source,
-                            verdict=verdict, now=self.conf.clock.now(),
-                            period=g.period, genesis=g.genesis_time,
-                            n=len(g), threshold=g.threshold, sender=sender)
+        self.flight.note_partial(p.round, index=idx, source=source,
+                                 verdict=verdict, now=self.conf.clock.now(),
+                                 period=g.period, genesis=g.genesis_time,
+                                 n=len(g), threshold=g.threshold,
+                                 sender=sender)
 
     # ------------------------------------------------------- service surface
     async def process_partial_beacon(self, from_addr: str,
@@ -307,12 +320,34 @@ class Handler(ProtocolService):
                 spawn(self._send_partial(node, packet))
 
     async def _send_partial(self, node, packet: PartialBeaconPacket) -> None:
+        from ...net.transport import PeerRejectedError
+
+        g = self.conf.group
         try:
             await self._client.partial_beacon(node.identity, packet)
+        except PeerRejectedError as e:
+            # the peer ANSWERED and rejected (stale window while it
+            # catches up, failed verification, ...): reachable — a
+            # lagging-but-alive peer must not read as a partition
+            self._l.debug("beacon_round", packet.round, err=str(e),
+                          to=node.address())
+            self.flight.note_send(node.index, True, n=len(g),
+                                  threshold=g.threshold)
+            return
         except TransportError as e:
             self._l.debug("beacon_round", packet.round, err_request=str(e),
                           to=node.address())
+            # transport failure = the peer is unreachable from here:
+            # feeds the reachability gauge + partition-suspect count
+            self.flight.note_send(node.index, False, n=len(g),
+                                  threshold=g.threshold)
+            return
         except asyncio.CancelledError:
             raise
-        except Exception as e:  # peer-side verification errors etc.
+        except Exception as e:  # peer-side errors on loopback transports
             self._l.debug("beacon_round", packet.round, err=str(e), to=node.address())
+            self.flight.note_send(node.index, True, n=len(g),
+                                  threshold=g.threshold)
+            return
+        self.flight.note_send(node.index, True, n=len(g),
+                              threshold=g.threshold)

@@ -265,10 +265,16 @@ class DiscrepancyStore(WrappedStore):
     — and hand the completed round's timeline to the OTLP exporter
     (obs/export, flushed off the hot path)."""
 
-    def __init__(self, inner: Store, group, clock):
+    def __init__(self, inner: Store, group, clock, health=None):
         super().__init__(inner)
         self._group = group
         self._clock = clock
+        # health-state override: the per-process HEALTH singleton unless
+        # an in-process multi-node harness injected one PER NODE (the
+        # chaos simulator) — without it, the singleton's monotonic-max
+        # head makes a minority-partition node's observations read the
+        # majority's progress
+        self._health = health
 
     def put(self, b: Beacon) -> None:
         self._inner.put(b)
@@ -280,14 +286,15 @@ class DiscrepancyStore(WrappedStore):
         from ..timelock import service as timelock_service
         from . import time_math
 
+        health = self._health if self._health is not None else HEALTH
         now = self._clock.now()
         expected = time_math.time_of_round(self._group.period,
                                            self._group.genesis_time, b.round)
         metrics.BEACON_DISCREPANCY_LATENCY.set((now - expected) * 1000.0)
         metrics.LAST_BEACON_ROUND.set(b.round)
-        HEALTH.note_round_stored(b.round, now - expected,
+        health.note_round_stored(b.round, now - expected,
                                  self._group.period)
-        HEALTH.observe_chain(now, self._group.period,
+        health.observe_chain(now, self._group.period,
                              self._group.genesis_time, b.round)
         obs_export.note_round_complete(b.round,
                                        self._group.get_genesis_seed())

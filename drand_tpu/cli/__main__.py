@@ -134,7 +134,8 @@ async def _run_daemon(args) -> None:
     if args.public_listen:
         http_task = asyncio.ensure_future(
             _serve_public(d, args.public_listen, logger, folder,
-                          timelock=not args.no_timelock))
+                          timelock=not args.no_timelock,
+                          gateway=gateway))
     await control.wait_shutdown()
     if http_task:
         http_task.cancel()
@@ -143,7 +144,7 @@ async def _run_daemon(args) -> None:
 
 
 async def _serve_public(d, listen: str, logger, folder: str,
-                        timelock: bool = True) -> None:
+                        timelock: bool = True, gateway=None) -> None:
     """Start the REST API once the beacon exists (daemon may still be
     pre-DKG at boot)."""
     from ..client.direct import DirectClient
@@ -171,6 +172,10 @@ async def _serve_public(d, listen: str, logger, folder: str,
         os.makedirs(os.path.dirname(db), exist_ok=True)
         tl_service = TimelockService(TimelockVault(db), client,
                                      logger=logger.named("timelock"))
+        if gateway is not None:
+            # non-HTTP clients submit over the public gRPC service:
+            # TimelockSubmit/TimelockStatus reuse this service verbatim
+            gateway.set_timelock(tl_service)
     server = PublicServer(client, logger=logger.named("http"),
                           peer_metrics_fn=peer_metrics,
                           enable_pprof=os.environ.get("DRAND_TPU_PPROF") == "1",

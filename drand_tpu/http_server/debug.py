@@ -82,7 +82,8 @@ async def _flight_rounds(request: web.Request) -> web.Response:
         return web.json_response({"error": "bad n"}, status=400)
     n = max(1, min(int(raw), FLIGHT.max_rounds))
     return web.json_response({"rounds": FLIGHT.rounds(n),
-                              "peers": FLIGHT.peers()})
+                              "peers": FLIGHT.peers(),
+                              "reach": FLIGHT.reachability()})
 
 
 async def _flight_dkg(request: web.Request) -> web.Response:

@@ -78,6 +78,27 @@ ingress, the aggregator, gossip validation and the DKG protocol):
       distinct valid contributors of the last stored round
   dkg_phase_seconds{phase}             [group]   DKG/reshare phase
       durations (deal | response | justification | finish)
+Fault-detection set (obs/flight.py reachability + obs/health.py stall
+detection, ISSUE 11 — the chaos simulator's oracle for faults the
+ISSUE-6/10 SLIs could not see; fed by the handler's outbound partial
+sends and the /healthz pull path):
+  beacon_peer_reachable{index}         [group]   1 while the last
+      outbound send to that group member succeeded, 0 after a failure
+      (index cardinality bounded by the group size, like
+      beacon_partial_events_total)
+  beacon_partition_suspects            [group]   count of group peers
+      currently unreachable from this node — when it reaches
+      n - threshold the node itself can no longer see a quorum
+  beacon_peer_sends_total{index,outcome} [group] outbound
+      partial-broadcast attempts per peer by outcome (ok | failed)
+  beacon_ingress_rejects_total{source,verdict} [group] partial/beacon
+      ingress rejections by ingress source and verdict (invalid |
+      stale | future | duplicate) — the flood/abuse signal the
+      per-peer counters cannot carry (window rejects and garbage
+      prefixes are deliberately never attributed to a peer)
+  chain_sync_stalled                   [group]   1 while the chain lags
+      beyond the readiness bound with no catch-up making progress
+      (pull-model: re-evaluated by /healthz probes and scrapes)
 Engine introspection (ISSUE 6):
   engine_compile_seconds{op}           [private] FIRST dispatch of each
       (op, path, batch-bucket) device shape — the jit compile +
@@ -290,6 +311,35 @@ DKG_PHASE_SECONDS = Histogram(
     "(deal|response|justification|finish)",
     ["phase"], registry=GROUP_REGISTRY,
     buckets=(0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0))
+
+# ---- fault detection (obs/flight.py reachability, obs/health.py) ----------
+PEER_REACHABLE = Gauge(
+    "beacon_peer_reachable",
+    "1 while the last outbound partial send to this group member "
+    "succeeded, 0 after a send failure (per share index)",
+    ["index"], registry=GROUP_REGISTRY)
+PARTITION_SUSPECTS = Gauge(
+    "beacon_partition_suspects",
+    "Group peers currently unreachable from this node by outbound send "
+    "result — at n minus threshold the node cannot see a quorum "
+    "(the partition-suspect early warning)",
+    registry=GROUP_REGISTRY)
+PEER_SENDS = Counter(
+    "beacon_peer_sends_total",
+    "Outbound partial-broadcast attempts per group member by outcome "
+    "(ok = delivered; failed = transport error / unreachable)",
+    ["index", "outcome"], registry=GROUP_REGISTRY)
+INGRESS_REJECTS = Counter(
+    "beacon_ingress_rejects_total",
+    "Partial/beacon ingress rejections by source (grpc|gossip|self) "
+    "and verdict (invalid|stale|future|duplicate) — the flood/abuse "
+    "visibility the peer-attributed counters deliberately do not carry",
+    ["source", "verdict"], registry=GROUP_REGISTRY)
+SYNC_STALLED = Gauge(
+    "chain_sync_stalled",
+    "1 while the chain head lags beyond the readiness bound and no "
+    "catch-up is making progress (re-evaluated by /healthz and scrapes)",
+    registry=GROUP_REGISTRY)
 
 # ---- OTLP export (obs/export.py) ------------------------------------------
 OTLP_EXPORT_ROUNDS = Counter(

@@ -24,7 +24,19 @@ from ..utils.logging import KVLogger, default_logger
 from . import protowire as pw
 from . import wire
 from .packets import PartialBeaconPacket, SyncRequest
-from .transport import ProtocolClient, ProtocolService, TransportError
+from .transport import (PeerRejectedError, ProtocolClient,
+                        ProtocolService, TransportError)
+
+# gRPC codes the GATEWAY maps application-level rejections onto
+# (INVALID_ARGUMENT for wire errors, FAILED_PRECONDITION for protocol
+# rejects, PERMISSION_DENIED for policy) — the peer answered, so these
+# raise PeerRejectedError; every other code (UNAVAILABLE,
+# DEADLINE_EXCEEDED, ...) is connectivity and stays TransportError.
+_REJECT_CODES = (grpc.StatusCode.INVALID_ARGUMENT,
+                 grpc.StatusCode.FAILED_PRECONDITION,
+                 grpc.StatusCode.PERMISSION_DENIED,
+                 grpc.StatusCode.NOT_FOUND,
+                 grpc.StatusCode.UNIMPLEMENTED)
 
 SERVICE = "drand.Protocol"
 PUBLIC_SERVICE = "drand.Public"  # protobuf interop surface (api.proto)
@@ -43,13 +55,26 @@ class GrpcGateway:
 
     def __init__(self, service: ProtocolService, listen: str,
                  logger: KVLogger | None = None,
-                 tls: tuple[str, str] | None = None):
+                 tls: tuple[str, str] | None = None,
+                 timelock_service=None):
         self._svc = service
         self._listen = listen
         self._l = logger or default_logger("grpc")
         self._tls = tls
+        # optional timelock vault front (drand_tpu/timelock, ISSUE 11
+        # carry-over from PR 9): mirrors the HTTP tier's POST /timelock
+        # + GET /timelock/{id} as TimelockSubmit/TimelockStatus on the
+        # public service, reusing TimelockService's canonicalization
+        # and validation verbatim. Attachable late (set_timelock) — the
+        # daemon builds the service only once the beacon exists.
+        self._timelock = timelock_service
         self._server: grpc.aio.Server | None = None
         self.port: int | None = None
+
+    def set_timelock(self, svc) -> None:
+        """Attach (or detach with None) the timelock service the
+        TimelockSubmit/TimelockStatus methods front."""
+        self._timelock = svc
 
     async def start(self) -> None:
         server = grpc.aio.server()
@@ -75,6 +100,12 @@ class GrpcGateway:
             "ChainInfo": grpc.unary_unary_rpc_method_handler(
                 self._pb_chain_info),
             "Home": grpc.unary_unary_rpc_method_handler(self._pb_home),
+            # timelock vault mirror of the HTTP tier (JSON bodies both
+            # ways — the same envelope a client POSTs to /timelock)
+            "TimelockSubmit": grpc.unary_unary_rpc_method_handler(
+                self._timelock_submit),
+            "TimelockStatus": grpc.unary_unary_rpc_method_handler(
+                self._timelock_status),
         }
         server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(PUBLIC_SERVICE, pub),))
@@ -385,6 +416,57 @@ class GrpcGateway:
         return pw.encode(pw.HOME_RESPONSE,
                          {"status": "drand-tpu up and running"})
 
+    # ------------------------------------------- timelock (JSON bodies)
+    async def _timelock_submit(self, request: bytes, context) -> bytes:
+        """drand.Public/TimelockSubmit: request = the envelope JSON a
+        client would POST to /timelock; response = the status record
+        JSON. Validation, canonicalization and the idempotent token are
+        TimelockService.submit — the HTTP tier's path, verbatim."""
+        import json
+
+        from ..timelock.service import TimelockError
+
+        if self._timelock is None:
+            await context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                                "timelock vault not enabled on this node")
+        try:
+            envelope = json.loads(request.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                "body is not JSON")
+        if not isinstance(envelope, dict):
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                "envelope is not a JSON object")
+        try:
+            rec = await self._timelock.submit(envelope)
+        except TimelockError as e:
+            msg = str(e)
+            code = (grpc.StatusCode.UNAVAILABLE
+                    if "chain info unavailable" in msg
+                    else grpc.StatusCode.INVALID_ARGUMENT)
+            await context.abort(code, msg)
+        return json.dumps(rec).encode()
+
+    async def _timelock_status(self, request: bytes, context) -> bytes:
+        """drand.Public/TimelockStatus: request = the ciphertext id
+        (utf-8 token); response = the status record JSON (the GET
+        /timelock/{id} body)."""
+        import json
+
+        if self._timelock is None:
+            await context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                                "timelock vault not enabled on this node")
+        try:
+            token = request.decode("utf-8").strip()
+        except UnicodeDecodeError:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                "token is not utf-8")
+        rec = await self._timelock.status(token)
+        if rec is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND,
+                                "unknown ciphertext id")
+        return json.dumps(rec).encode()
+
 
 class GrpcClient(ProtocolClient):
     """Outbound calls with a per-peer channel pool (client_grpc.go:271)."""
@@ -443,7 +525,9 @@ class GrpcClient(ProtocolClient):
             from .. import metrics
 
             metrics.DIAL_FAILURES.labels(peer=target).inc()
-            raise TransportError(
+            cls = (PeerRejectedError if e.code() in _REJECT_CODES
+                   else TransportError)
+            raise cls(
                 f"{target} {method}: {e.code().name} {e.details()}") from e
 
     # ------------------------------------------------------ ProtocolClient
@@ -497,6 +581,40 @@ class GrpcClient(ProtocolClient):
                                SyncRequest(from_round=round_no))
         msg, _ = wire.decode(raw)
         return msg
+
+    # --------------------------------------------------- timelock mirror
+    async def timelock_submit(self, peer, envelope: dict) -> dict:
+        """Submit a timelock envelope over drand.Public (the gRPC
+        mirror of POST /timelock). Returns the status record."""
+        import json
+
+        ch, target = self._channel(peer)
+        fn = ch.unary_unary(f"/{PUBLIC_SERVICE}/TimelockSubmit")
+        try:
+            raw = await fn(json.dumps(envelope).encode(),
+                           timeout=self._timeout)
+        except grpc.aio.AioRpcError as e:
+            raise TransportError(
+                f"{target} TimelockSubmit: {e.code().name} "
+                f"{e.details()}") from e
+        return json.loads(raw.decode())
+
+    async def timelock_status(self, peer, token: str) -> dict | None:
+        """The ciphertext's status record (GET /timelock/{id} mirror);
+        None for an unknown id."""
+        import json
+
+        ch, target = self._channel(peer)
+        fn = ch.unary_unary(f"/{PUBLIC_SERVICE}/TimelockStatus")
+        try:
+            raw = await fn(token.encode(), timeout=self._timeout)
+        except grpc.aio.AioRpcError as e:
+            if e.code() == grpc.StatusCode.NOT_FOUND:
+                return None
+            raise TransportError(
+                f"{target} TimelockStatus: {e.code().name} "
+                f"{e.details()}") from e
+        return json.loads(raw.decode())
 
     async def public_rand_stream(self, peer):
         ch, target = self._channel(peer)

@@ -26,6 +26,15 @@ class TransportError(Exception):
     pass
 
 
+class PeerRejectedError(TransportError):
+    """The peer ANSWERED and rejected the request (stale/future window,
+    failed verification, its own policy) — reachability-wise the
+    opposite of a TransportError: the link is fine. Callers feeding
+    reachability SLIs (handler._send_partial) must not count these as
+    unreachability; conflating them turns every lagging-but-alive peer
+    into a phantom partition suspect."""
+
+
 class ProtocolClient:
     """Outbound node->node calls (reference net/client.go:30-49)."""
 
@@ -149,7 +158,14 @@ class LocalClient(ProtocolClient):
 
     async def partial_beacon(self, peer, packet: PartialBeaconPacket) -> None:
         svc = self._net._target(self._addr, peer)
-        await svc.process_partial_beacon(self._addr, packet)
+        try:
+            await svc.process_partial_beacon(self._addr, packet)
+        except PeerRejectedError:
+            raise
+        except TransportError as e:
+            # _target already raised for unreachability; an error from
+            # the service itself is the PEER's verdict — it answered
+            raise PeerRejectedError(str(e)) from e
 
     async def sync_chain(self, peer, req: SyncRequest) -> AsyncIterator[Beacon]:
         svc = self._net._target(self._addr, peer)

@@ -95,6 +95,31 @@ def _phase_hist(phase: str):
     return metrics.DKG_PHASE_SECONDS.labels(phase="finish")
 
 
+def _reject_counter(source: str, verdict: str):
+    """Branch-literal source labels (the check_metrics enum rule);
+    verdict values are the handler/gossip rejection strings — bounded
+    by the code paths that mint them, passed through as-is."""
+    from .. import metrics
+
+    if source == "gossip":
+        return metrics.INGRESS_REJECTS.labels(source="gossip",
+                                              verdict=verdict)
+    if source == "self":
+        return metrics.INGRESS_REJECTS.labels(source="self",
+                                              verdict=verdict)
+    return metrics.INGRESS_REJECTS.labels(source="grpc", verdict=verdict)
+
+
+def _send_counter(index: int, ok: bool):
+    """Branch-literal outcome labels for beacon_peer_sends_total (the
+    check_metrics KNOWN_LABEL_VALUES enum rule)."""
+    from .. import metrics
+
+    if ok:
+        return metrics.PEER_SENDS.labels(outcome="ok", index=str(index))
+    return metrics.PEER_SENDS.labels(outcome="failed", index=str(index))
+
+
 class FlightRecorder:
     """Bounded per-round ring of partial-arrival events + aggregation
     milestones, plus cumulative per-peer counters.
@@ -113,6 +138,9 @@ class FlightRecorder:
         self._rounds: OrderedDict[int, dict] = OrderedDict()
         # share index -> {"contributed","late","invalid"} totals
         self._peers: dict[int, dict] = {}
+        # share index -> last outbound send succeeded (reachability;
+        # fed by the handler's per-peer broadcast results)
+        self._reach: dict[int, bool] = {}
         self.dkg = DKGFlight()
 
     # ------------------------------------------------------------ helpers
@@ -221,6 +249,12 @@ class FlightRecorder:
                     self._peer(index)["invalid"] += 1
         if valid:
             _arrival_hist(source).observe(max(0.0, offset))
+        if ev["verdict"] != VALID:
+            # every rejection — attributable or not — lands on the
+            # flood/abuse counter (a garbage-prefix or window-reject
+            # flood is otherwise invisible: it may not attribute to a
+            # peer nor create a ring entry, by design)
+            _reject_counter(source, ev["verdict"]).inc()
         if attributable:
             if valid:
                 metrics.PARTIAL_EVENTS.labels(event="contributed",
@@ -231,6 +265,35 @@ class FlightRecorder:
             elif verdict == "invalid":
                 metrics.PARTIAL_EVENTS.labels(event="invalid",
                                               index=str(index)).inc()
+
+    def note_send(self, index: int, ok: bool, *, n: int | None = None,
+                  threshold: int | None = None) -> None:
+        """One outbound partial-broadcast result to the group member at
+        ``index`` (the handler's per-peer send fan-out). Maintains the
+        per-peer reachability gauge and the partition-suspect count —
+        the fault the quorum SLIs cannot see from the SENDING side: a
+        partitioned node watches its peers go unreachable rounds before
+        its own chain stalls. Out-of-group indices are ignored (same
+        cardinality rule as note_partial attribution)."""
+        from .. import metrics
+
+        if n is not None and not 0 <= index < n:
+            return
+        with self._lock:
+            changed = self._reach.get(index) is not ok
+            self._reach[index] = ok
+            suspects = sum(1 for up in self._reach.values() if not up)
+        _send_counter(index, ok).inc()
+        if changed:
+            metrics.PEER_REACHABLE.labels(index=str(index)).set(
+                1 if ok else 0)
+        metrics.PARTITION_SUSPECTS.set(suspects)
+
+    def reachability(self) -> dict[str, bool]:
+        """Per-share-index reachability by last outbound send result
+        (JSON-keyed; absent index = never sent to)."""
+        with self._lock:
+            return {str(i): up for i, up in sorted(self._reach.items())}
 
     def note_quorum(self, round_no: int, *, have: int, threshold: int,
                     now: float, period: int, genesis: int,
@@ -334,6 +397,7 @@ class FlightRecorder:
         with self._lock:
             self._rounds.clear()
             self._peers.clear()
+            self._reach.clear()
         self.dkg.reset()
 
 

@@ -56,6 +56,8 @@ class HealthState:
         self._sync = {"active": False, "rounds_per_sec": 0.0,
                       "eta_seconds": 0.0, "done": 0, "target": 0,
                       "current": 0}
+        # lagging-with-no-progress verdict of the last observe_chain
+        self._sync_stalled = False
 
     # ------------------------------------------------------------ inputs
     def note_dkg_complete(self) -> None:
@@ -124,11 +126,24 @@ class HealthState:
                 self._missed_marker = max(self._missed_marker, overdue_to,
                                           head)
             missed = self._missed_total
+            # sync-stall (ISSUE 11, pull-model like the gauges above): a
+            # node lagging beyond the readiness bound SHOULD be syncing;
+            # when no follow is active — or one is but its throughput is
+            # zero — the lag will never close on its own. Scrapes and
+            # health probes drive it, so a fully wedged node still
+            # surfaces. Guarded on a known head like the missed counter:
+            # a pre-first-beacon node is bootstrapping, not stalled.
+            stalled = (head > 0 and lag > READY_MAX_LAG
+                       and (not self._sync["active"]
+                            or self._sync["rounds_per_sec"] == 0.0))
+            self._sync_stalled = stalled
         metrics.CHAIN_HEAD_LAG.set(lag)
+        metrics.SYNC_STALLED.set(1 if stalled else 0)
         if newly:
             metrics.MISSED_ROUNDS.inc(newly)
         return {"head_round": head, "expected_round": expected,
-                "lag_rounds": lag, "missed_total": missed}
+                "lag_rounds": lag, "missed_total": missed,
+                "sync_stalled": stalled}
 
     def note_sync_progress(self, done: int, elapsed_s: float,
                            current: int, target: int,
@@ -171,6 +186,7 @@ class HealthState:
                 "slo_window": n,
                 "slo_late_fraction": (late / n) if n else 0.0,
                 "sync": dict(self._sync),
+                "sync_stalled": self._sync_stalled,
             }
 
     def reset(self) -> None:
@@ -185,6 +201,7 @@ class HealthState:
             self._sync = {"active": False, "rounds_per_sec": 0.0,
                           "eta_seconds": 0.0, "done": 0, "target": 0,
                           "current": 0}
+            self._sync_stalled = False
 
 
 def is_ready(snapshot: dict, max_lag: int | None = None) -> bool:

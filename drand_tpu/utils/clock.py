@@ -60,6 +60,14 @@ class FakeClock(Clock):
         heapq.heappush(self._waiters, (self._now + seconds, next(self._counter), fut))
         await fut
 
+    def next_wake(self) -> float | None:
+        """Earliest pending wake target, or None when nothing sleeps.
+        Lets harnesses (testing/chaos.py) step time deterministically
+        from wake target to wake target instead of jumping a whole
+        window — the clock then PARKS between targets, so everything a
+        delivery triggers is timestamped at the delivery time."""
+        return self._waiters[0][0] if self._waiters else None
+
     def _wake_due(self) -> bool:
         woke = False
         while self._waiters and self._waiters[0][0] <= self._now:

@@ -9,6 +9,7 @@ import pytest
 from drand_tpu.client.direct import DirectClient
 from drand_tpu.http_server.server import PublicServer
 from drand_tpu.obs import trace
+from drand_tpu.obs.state import reset_observability
 from drand_tpu.testing.harness import BeaconTestNetwork
 
 
@@ -62,7 +63,7 @@ async def test_trace_rounds_timeline(caplog):
     """ISSUE 1 acceptance: a harness round yields a /debug/trace/rounds
     timeline with the named pipeline stages, on the SAME deterministic
     trace id every node derives, and that id shows up in the KV logs."""
-    trace.TRACER.reset()
+    reset_observability()
     net = BeaconTestNetwork(n=3, t=2, period=5)
     _capture_harness_logs(caplog)
     await net.start_all()

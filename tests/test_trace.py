@@ -14,10 +14,13 @@ from drand_tpu.utils.logging import KVLogger, default_logger
 
 
 @pytest.fixture(autouse=True)
-def _fresh_tracer():
-    trace.TRACER.reset()
-    yield
-    trace.TRACER.reset()
+def _fresh_obs():
+    # the scoped helper (obs/state.py): every singleton reset on entry
+    # AND exit, so no scenario inherits or bequeaths recorder state
+    from drand_tpu.obs.state import isolated_observability
+
+    with isolated_observability():
+        yield
 
 
 def _stage_count(stage: str) -> float:

@@ -186,6 +186,28 @@ def test_secretflow_laundering_and_module_alias(tmp_path):
     assert findings[0].rule == "secret-in-print"
 
 
+def test_secretflow_catches_secret_logging_chaos_scenario(tmp_path):
+    """ISSUE 11 satellite: a chaos fault-schedule harness that logs a
+    node's secret share while reporting a fault (the exact hygiene
+    violation the chaos suite asserts never happens at runtime) is a
+    HIGH secretflow finding — the static gate backs the runtime check,
+    so a scenario author cannot even merge the leak."""
+    proj = _project(tmp_path, {
+        "testing/chaos_ext.py": """
+            def report_byzantine(logger, metrics_counter, node, share):
+                pri_share = share.pri_share
+                logger.warn("chaos", "byzantine_detected",
+                            node=node, share=pri_share)
+                metrics_counter.labels(peer=str(pri_share)).inc()
+        """,
+    })
+    findings = secretflow.run(proj)
+    rules = sorted(f.rule for f in findings)
+    assert rules == ["secret-in-log", "secret-in-metric-label"]
+    assert all(f.severity == "high" for f in findings)
+    assert all(f.path == "testing/chaos_ext.py" for f in findings)
+
+
 # ---------------------------------------------------------------------------
 # jaxhazard
 # ---------------------------------------------------------------------------
@@ -546,10 +568,10 @@ async def test_healthz_answers_while_large_span_verifies(monkeypatch):
     from drand_tpu.chain.store import CallbackStore, MemStore
     from drand_tpu.crypto import batch
     from drand_tpu.http_server.server import PublicServer
-    from drand_tpu.obs.health import HEALTH
+    from drand_tpu.obs.state import reset_observability
     from drand_tpu.utils.logging import default_logger
 
-    HEALTH.reset()
+    reset_observability()
     in_verify = threading.Event()
 
     def slow_verify(pub, chunk, dst=None):
@@ -604,4 +626,4 @@ async def test_healthz_answers_while_large_span_verifies(monkeypatch):
         assert store.last().round == 64
     finally:
         await server.stop()
-        HEALTH.reset()
+        reset_observability()

@@ -20,6 +20,7 @@ from drand_tpu import metrics
 from drand_tpu.dkg import DKGConfig, DKGProtocol, LocalBoard
 from drand_tpu.http_server.debug import add_trace_routes
 from drand_tpu.obs.flight import FLIGHT, FlightRecorder
+from drand_tpu.obs.state import reset_observability
 from drand_tpu.testing.harness import BeaconTestNetwork
 from drand_tpu.utils.clock import FakeClock
 
@@ -232,7 +233,7 @@ async def test_dkg_phase_timeline_with_crashed_dealer():
     timeline shows deal-phase arrivals from exactly dealers 0-3, a
     deal phase that lasted the full 10 s timeout (the crash is VISIBLE
     as the stall), QUAL [0,1,2,3], and dkg_phase_seconds samples."""
-    FLIGHT.dkg.reset()
+    reset_observability()
     n, t = 5, 3
     pairs, nodes = _make_dkg_nodes(n)
     clock = FakeClock()
@@ -303,7 +304,7 @@ async def test_network_flight_records_and_dead_peer_degrades():
     positive quorum margins; killing node 2 degrades the bitmap to
     '##.' and sets the contribution gap — all while rounds still
     aggregate (the early-warning half of the acceptance demo)."""
-    FLIGHT.reset()
+    reset_observability()
     net = BeaconTestNetwork(n=3, t=2, period=5)
     await net.start_all()
     await net.advance_to_genesis()
@@ -358,7 +359,7 @@ async def test_network_flight_records_and_dead_peer_degrades():
 
 @pytest.mark.asyncio
 async def test_debug_flight_routes_and_cli_rendering(capsys):
-    FLIGHT.reset()
+    reset_observability()
     _feed(FLIGHT, 41, 0, 0.5)
     _feed(FLIGHT, 41, 1, 6.0)
     _feed(FLIGHT, 41, 3, 0.7, verdict="invalid")

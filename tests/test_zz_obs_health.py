@@ -25,6 +25,7 @@ from drand_tpu.http_server.server import PublicServer
 from drand_tpu.obs import export as obs_export
 from drand_tpu.obs import trace
 from drand_tpu.obs.health import HEALTH, HealthState
+from drand_tpu.obs.state import reset_observability
 from drand_tpu.testing.harness import BeaconTestNetwork
 
 N, T, PERIOD = 3, 2, 5
@@ -63,8 +64,7 @@ async def test_healthz_readyz_transitions(monkeypatch, tmp_path):
     monkeypatch.setenv("DRAND_TPU_OTLP_SPOOL", spool)
     monkeypatch.delenv("DRAND_TPU_OTLP_ENDPOINT", raising=False)
     obs_export.reset_exporter()
-    HEALTH.reset()
-    trace.TRACER.reset()
+    reset_observability()
     lat0 = _sample_count(metrics.GROUP_REGISTRY,
                          "beacon_round_lateness_seconds")
     net = BeaconTestNetwork(n=N, t=T, period=PERIOD)

@@ -478,3 +478,81 @@ def test_device_round_open_one_dispatch_meter_and_oracle_identical():
                             batch=bucket) == h0 + 1
     finally:
         batch._MODE, batch._MIN_BATCH, batch._ENGINE = old
+
+
+# ------------------------------------------------------------- gRPC mirror
+
+@pytest.mark.asyncio
+async def test_grpc_timelock_submit_status_mirror(tmp_path, host_mode):
+    """The drand.Public TimelockSubmit/TimelockStatus methods mirror
+    POST /timelock + GET /timelock/{id} for non-HTTP clients (ISSUE 11
+    satellite, PR-9 carry-over): same envelope JSON in, same status
+    record out, the SAME TimelockService.submit canonicalization path
+    (idempotent token across encodings), and the HTTP error taxonomy
+    mapped onto grpc codes. A node without a vault answers
+    UNIMPLEMENTED."""
+    import grpc
+
+    from drand_tpu.net.grpc_transport import GrpcClient, GrpcGateway
+    from drand_tpu.net.transport import ProtocolService, TransportError
+    from drand_tpu.timelock import TimelockService, TimelockVault
+
+    chain = FakeChain(head=1)
+    svc = TimelockService(TimelockVault(str(tmp_path / "tl.db")), chain)
+    gw = GrpcGateway(ProtocolService(), "127.0.0.1:0",
+                     timelock_service=svc)
+    await gw.start()
+    await svc.start()
+    cli = GrpcClient(own_addr="tester:0")
+    target = f"127.0.0.1:{gw.port}"
+    try:
+        env = client_timelock.encrypt_to_round(INFO, 3, b"grpc sealed")
+        rec = await cli.timelock_submit(target, env)
+        assert rec["status"] == "pending" and rec["round"] == 3
+        token = rec["id"]
+        # idempotent resubmission — the HTTP tier's content-derived
+        # token, because it IS the HTTP tier's submit path
+        assert (await cli.timelock_submit(target, env))["id"] == token
+        # status roundtrip + unknown id -> None (NOT_FOUND)
+        st = await cli.timelock_status(target, token)
+        assert st["status"] == "pending" and st["id"] == token
+        assert await cli.timelock_status(target, "deadbeef") is None
+        # validation errors map to INVALID_ARGUMENT
+        bad = dict(env)
+        bad["chain_hash"] = "cd" * 32
+        with pytest.raises(TransportError, match="INVALID_ARGUMENT"):
+            await cli.timelock_submit(target, bad)
+        with pytest.raises(TransportError, match="INVALID_ARGUMENT"):
+            raw = cli._channel(target)[0].unary_unary(
+                "/drand.Public/TimelockSubmit")
+            try:
+                await raw(b"not json", timeout=5.0)
+            except grpc.aio.AioRpcError as e:
+                raise TransportError(
+                    f"TimelockSubmit: {e.code().name}") from e
+        # the boundary opens it; the gRPC status serves the plaintext
+        chain.head = 3
+        svc.on_result(await chain.get(3))
+        for _ in range(200):
+            await asyncio.sleep(0.02)
+            st = await cli.timelock_status(target, token)
+            if st["status"] != "pending":
+                break
+        assert st["status"] == "opened"
+        assert base64.b64decode(st["plaintext"]) == b"grpc sealed"
+    finally:
+        await cli.close()
+        await svc.close()
+        await gw.stop()
+
+    # a gateway with no vault attached answers UNIMPLEMENTED
+    gw2 = GrpcGateway(ProtocolService(), "127.0.0.1:0")
+    await gw2.start()
+    cli2 = GrpcClient(own_addr="tester:0")
+    try:
+        with pytest.raises(TransportError, match="UNIMPLEMENTED"):
+            await cli2.timelock_submit(f"127.0.0.1:{gw2.port}",
+                                       {"round": 3})
+    finally:
+        await cli2.close()
+        await gw2.stop()

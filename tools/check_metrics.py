@@ -77,6 +77,18 @@ KNOWN_LABEL_VALUES = {
                                               "invalid"}},
     "dkg_phase_seconds": {"phase": {"deal", "response", "justification",
                                     "finish"}},
+    # fault-detection set (obs/flight.py reachability, ISSUE 11). The
+    # `index` label of beacon_peer_sends_total / beacon_peer_reachable
+    # is the share index — dynamic but bounded by the group size (the
+    # beacon_partial_events_total rule), so only the `outcome` enum is
+    # pinned here.
+    "beacon_peer_sends_total": {"outcome": {"ok", "failed"}},
+    # the `verdict` label is the handler/gossip rejection string —
+    # minted only by code paths (invalid/stale/future/duplicate),
+    # passed through a variable so only `source` is literal-checkable
+    # here
+    "beacon_ingress_rejects_total": {"source": {"grpc", "gossip",
+                                                "self"}},
 }
 
 

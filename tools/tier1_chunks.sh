@@ -18,7 +18,16 @@
 #
 # --list prints the chunk -> file assignment (one line per chunk) and
 # exits 0 without running anything, so a CI log's chunked verdicts are
-# auditable against exactly which files each chunk covered.
+# auditable against exactly which files each chunk covered. It also
+# flags any KNOWN-CONFLICTING pair that still shares a chunk (only
+# possible at N=1).
+#
+# Known-conflicting pairs (CONFLICTS below) are separated STRUCTURALLY:
+# after the round-robin assignment, the later member of a pair that
+# landed in the same chunk is moved to the next chunk — the PR-10 note
+# (test_daemon + test_mock_and_scale contention-flake the reshare
+# timeout when run back to back on the 1-core box) no longer depends
+# on round-robin luck as the file list grows.
 #
 # Registration is by glob: every tests/test_*.py is picked up
 # automatically. New suites MUST keep the conventions the chunking
@@ -28,13 +37,23 @@
 #   test_zz_analyze.py     static-analysis suite (host-only, <60 s,
 #                          no backend init — pure AST + one aiohttp
 #                          harness)
+#   test_zz_chaos.py       chaos network simulator (host-only,
+#                          structural crypto — no pairings, no
+#                          compiles; ~10 s)
 #   test_zz_flight.py      threshold flight recorder suite (host-only)
 #   test_zz_obs_health.py  chain-health SLO / OTLP export suite
+#   test_zz_timelock_serve.py  timelock serving tier
 #
 # Exit status: 0 iff every chunk passed.
 
 set -u
 cd "$(dirname "$0")/.."
+
+# pairs that must never share a chunk (space-separated file names);
+# keep each pair alphabetically ordered — the SECOND member moves
+CONFLICTS=(
+    "tests/test_daemon.py tests/test_mock_and_scale.py"
+)
 
 # first arg is N only when it is a positive integer — otherwise it is a
 # pytest arg and the default chunk count applies (a bad N must never
@@ -58,32 +77,84 @@ fi
 FILES=()
 while IFS= read -r f; do FILES+=("$f"); done < <(ls tests/test_*.py | sort)
 
-if [ "$LIST" -eq 1 ]; then
-    for ((i = 0; i < N; i++)); do
-        chunk=()
-        for ((j = i; j < ${#FILES[@]}; j += N)); do
-            chunk+=("${FILES[j]}")
-        done
-        echo "chunk $((i + 1))/$N: ${chunk[*]:-}"
+# round-robin assignment: chunk_of[i] = i % N
+chunk_of=()
+for ((i = 0; i < ${#FILES[@]}; i++)); do
+    chunk_of[i]=$((i % N))
+done
+
+# find_pair_indices <a> <b>: sets PAIR_IA/PAIR_IB to the FILES indices
+# (-1 when absent) — the one pair-matching rule, shared by the resolver
+# and the flagger so they can never diverge
+find_pair_indices() {
+    PAIR_IA=-1 PAIR_IB=-1
+    local i
+    for ((i = 0; i < ${#FILES[@]}; i++)); do
+        [ "${FILES[i]}" = "$1" ] && PAIR_IA=$i
+        [ "${FILES[i]}" = "$2" ] && PAIR_IB=$i
     done
+}
+
+# structural conflict separation: move the later member of a
+# same-chunk conflicting pair to the next chunk (deterministic; a
+# no-op when round-robin already separated them or N=1)
+if [ "$N" -gt 1 ]; then
+    for pair in "${CONFLICTS[@]}"; do
+        read -r a b <<<"$pair"
+        find_pair_indices "$a" "$b"
+        if [ "$PAIR_IA" -ge 0 ] && [ "$PAIR_IB" -ge 0 ] &&
+            [ "${chunk_of[PAIR_IA]}" -eq "${chunk_of[PAIR_IB]}" ]; then
+            chunk_of[PAIR_IB]=$(((chunk_of[PAIR_IB] + 1) % N))
+        fi
+    done
+fi
+
+# flag any conflicting pair still sharing a chunk (N=1, or a future
+# three-way conflict the one-step move cannot untangle)
+flag_conflicts() {
+    local rc=0
+    for pair in "${CONFLICTS[@]}"; do
+        read -r a b <<<"$pair"
+        find_pair_indices "$a" "$b"
+        if [ "$PAIR_IA" -ge 0 ] && [ "$PAIR_IB" -ge 0 ] &&
+            [ "${chunk_of[PAIR_IA]}" -eq "${chunk_of[PAIR_IB]}" ]; then
+            echo "WARNING: known-conflicting pair in one chunk" \
+                "($((chunk_of[PAIR_IA] + 1))/$N): $a + $b" >&2
+            rc=1
+        fi
+    done
+    return $rc
+}
+
+if [ "$LIST" -eq 1 ]; then
+    for ((c = 0; c < N; c++)); do
+        chunk=()
+        for ((i = 0; i < ${#FILES[@]}; i++)); do
+            [ "${chunk_of[i]}" -eq "$c" ] && chunk+=("${FILES[i]}")
+        done
+        echo "chunk $((c + 1))/$N: ${chunk[*]:-}"
+    done
+    flag_conflicts
     exit 0
 fi
 
+flag_conflicts || true
+
 fail=0
-for ((i = 0; i < N; i++)); do
+for ((c = 0; c < N; c++)); do
     chunk=()
-    for ((j = i; j < ${#FILES[@]}; j += N)); do
-        chunk+=("${FILES[j]}")
+    for ((i = 0; i < ${#FILES[@]}; i++)); do
+        [ "${chunk_of[i]}" -eq "$c" ] && chunk+=("${FILES[i]}")
     done
     [ ${#chunk[@]} -eq 0 ] && continue
-    echo "=== chunk $((i + 1))/$N: ${chunk[*]}" >&2
+    echo "=== chunk $((c + 1))/$N: ${chunk[*]}" >&2
     timeout -k 10 "${CHUNK_TIMEOUT:-870}" \
         env JAX_PLATFORMS=cpu python -m pytest "${chunk[@]}" -q \
         -m 'not slow' --continue-on-collection-errors \
         -p no:cacheprovider -p no:xdist -p no:randomly "$@"
     rc=$?
     if [ $rc -ne 0 ]; then
-        echo "=== chunk $((i + 1))/$N FAILED (rc=$rc)" >&2
+        echo "=== chunk $((c + 1))/$N FAILED (rc=$rc)" >&2
         fail=1
     fi
 done
