@@ -603,16 +603,60 @@ def bench_chaos_soak(trials):
         finally:
             net.stop_all()
 
+    async def drop_soak(repair: bool):
+        """The ISSUE-12 `repair` variant: the same 32-node schedule
+        family, but the fault is a drop-the-push storm — EVERY partial
+        push silently lost in flight for three rounds (receiver-side
+        loss, exactly what the quorum-repair pull defeats: the pull
+        path models a fresh connection and is not subject to the link
+        policy). Run once with repair off (the pre-ISSUE-12 plane: the
+        rounds miss) and once on (zero missed, recovery collapses)."""
+        net = ChaosBeaconNetwork(n=n, t=t, period=period, repair=repair)
+        await net.start_all()
+        await net.advance_to_genesis()
+        sched = [
+            FaultEvent(fault_round, "link_all",
+                       {"policy": LinkPolicy(drop=1.0)}),
+            FaultEvent(heal_round, "heal"),
+        ]
+        try:
+            return await net.run_schedule(sched, rounds=rounds)
+        finally:
+            net.stop_all()
+
     t0 = time.perf_counter()
     with structural_crypto(), isolated_observability():
         obs = asyncio.run(soak())
-    wall = time.perf_counter() - t0
     lead = detection_lead(obs, period)
     rec = recovery_seconds(obs, heal_round, period)
     missed = max(ob.missed_total for ob in obs)
     if lead["lead_rounds"] is None or rec is None:
         raise RuntimeError(
             f"chaos soak inconclusive: lead={lead} recovery={rec}")
+    log("chaos_soak: drop-the-push variant, repair off")
+    with structural_crypto(), isolated_observability():
+        obs_off = asyncio.run(drop_soak(repair=False))
+    log("chaos_soak: drop-the-push variant, repair on")
+    with structural_crypto(), isolated_observability():
+        obs_on = asyncio.run(drop_soak(repair=True))
+    wall = time.perf_counter() - t0
+    missed_off = max(ob.missed_total for ob in obs_off)
+    missed_on = max(ob.missed_total for ob in obs_on)
+    rec_off = recovery_seconds(obs_off, heal_round, period)
+    rec_on = recovery_seconds(obs_on, heal_round, period)
+    if missed_off == 0:
+        raise RuntimeError("repair variant inconclusive: the drop "
+                           "schedule missed nothing even without repair")
+    # the repair-on leg is the CLAIM, not a bystander: a quorum-repair
+    # regression must fail the bench, not quietly skew a JSON field
+    if missed_on:
+        raise RuntimeError(
+            f"repair variant regressed: {missed_on} rounds missed "
+            f"WITH repair enabled (without: {missed_off})")
+    if rec_on is None or (rec_off is not None and rec_on >= rec_off):
+        raise RuntimeError(
+            f"repair variant regressed: recovery {rec_on}s with repair "
+            f"vs {rec_off}s without")
     return {"metric": "chaos_soak_detection_lead",
             "value": float(lead["lead_seconds"]), "unit": "s",
             "nodes": n, "threshold": t, "period_s": period,
@@ -622,6 +666,13 @@ def bench_chaos_soak(trials):
             "missed_round": lead["missed_round"],
             "missed_rounds_total": missed,
             "recovery_seconds": rec,
+            "repair": {
+                "schedule": "drop_the_push",
+                "missed_without_repair": missed_off,
+                "missed_with_repair": missed_on,
+                "recovery_seconds_without_repair": rec_off,
+                "recovery_seconds_with_repair": rec_on,
+            },
             "wall_seconds": round(wall, 1),
             "vs_baseline": None}
 

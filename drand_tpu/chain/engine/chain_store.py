@@ -56,12 +56,20 @@ class ChainStore(CallbackStore):
         self._client = client
         self._crypto = crypto
         self._ticker = ticker
-        self.sync = Syncer(logger.named("sync"), self, crypto.chain_info, client)
+        self.sync = Syncer(logger.named("sync"), self, crypto.chain_info,
+                           client, clock=conf.clock)
         # single merged event queue: ("stored", Beacon) | ("partial", _PartialInfo)
         # — one consumer, no multi-queue cancellation races
         self._events: asyncio.Queue[tuple[str, object]] = asyncio.Queue(maxsize=512)
         # notifies the Handler when a beacon was aggregated without sync
         self.catchup_beacons: asyncio.Queue[Beacon] = asyncio.Queue(maxsize=1)
+        # the collector's per-round partial set. An attribute (not an
+        # aggregator-loop local) so quorum repair can read it: the
+        # handler SERVES these to pulling peers and reads its own gap
+        # before pulling. Loop-thread-only access by construction —
+        # the aggregator mutates it from _process_event and the
+        # handler's service surface runs on the same loop.
+        self.cache = PartialCache()
         self._agg_task: asyncio.Task | None = None
         self.add_callback("chainstore", self._on_stored)
 
@@ -84,9 +92,32 @@ class ChainStore(CallbackStore):
         except asyncio.QueueFull:
             self._l.warn("aggregator", "partial_queue_full", dropping=p.round)
 
+    def partial_indices(self, round_no: int,
+                        previous_sig: bytes) -> set[int]:
+        """Share indices of the valid partials collected for one round
+        (empty when nothing was collected) — the quorum-repair gap
+        check. Valid-only by construction: everything in the cache
+        passed ingress verification, so a repair trigger can never be
+        driven by UNVERIFIED-index events."""
+        rc = self.cache.get_round_cache(round_no, previous_sig)
+        return set(rc.sigs) if rc is not None else set()
+
+    def partials_for(self, round_no: int, previous_sig: bytes,
+                     exclude: set[int]) -> list[PartialBeaconPacket]:
+        """The collected partial packets for one round, minus
+        ``exclude`` — what a repair PULL serves. Bounded by the group
+        size (the cache holds at most one partial per index)."""
+        rc = self.cache.get_round_cache(round_no, previous_sig)
+        if rc is None:
+            return []
+        return [PartialBeaconPacket(
+                    round=rc.round, previous_sig=rc.prev, partial_sig=sig,
+                    partial_sig_v2=rc.sigs_v2.get(idx, b""))
+                for idx, sig in rc.sigs.items() if idx not in exclude]
+
     async def _run_aggregator(self) -> None:
         last = self.last()
-        cache = PartialCache()
+        cache = self.cache
         while True:
             kind, payload = await self._events.get()
             try:

@@ -8,19 +8,23 @@ and broadcast to all peers; fast-path catchup when the chain lags.
 from __future__ import annotations
 
 import asyncio
+import os
 from dataclasses import dataclass
 from typing import AsyncIterator
 
 from ...crypto import tbls
 from ...key.group import Group
 from ...key.keys import Node, Share
-from ...net.packets import PartialBeaconPacket, SyncRequest
-from ...net.transport import ProtocolClient, ProtocolService, TransportError
+from ...net.packets import PartialBeaconPacket, PartialRequest, SyncRequest
+from ...net.transport import (BreakerOpenError, PeerBreaker,
+                              PeerRejectedError, ProtocolClient,
+                              ProtocolService, TransportError)
 from ...obs.flight import FLIGHT, FlightRecorder
 from ...obs.trace import TRACER
 from ...utils.aio import spawn
 from ...utils.clock import Clock
 from ...utils.logging import KVLogger
+from ...utils.retry import RetryPolicy, retry
 from .. import beacon as chain_beacon
 from .. import time_math
 from ..beacon import Beacon
@@ -28,6 +32,26 @@ from ..store import Store, genesis_beacon
 from .chain_store import ChainStore
 from .crypto import CryptoStore
 from .ticker import Ticker
+
+# partial-send retry budget (total tries per peer per round; the
+# breaker gates every attempt, so a partitioned peer never sees a storm)
+SEND_RETRY_ATTEMPTS = int(os.environ.get("DRAND_TPU_SEND_RETRIES", "3"))
+# quorum repair (ISSUE 12) fires when the live round's quorum margin
+# has shrunk below this fraction of the period (i.e. at
+# (1 - fraction) * period past the boundary) while valid partials < t;
+# 0 disables repair entirely
+REPAIR_MARGIN_FRACTION = float(
+    os.environ.get("DRAND_TPU_REPAIR_FRACTION", "0.25"))
+# repair pulls SERVED per sender per round before refusing at the door
+REPAIR_SERVE_CAP = int(os.environ.get("DRAND_TPU_REPAIR_SERVE_CAP", "4"))
+
+
+def _breaker_gauge(index: int, state: int) -> None:
+    """beacon_peer_breaker_state{index} export (index cardinality
+    bounded by the group size, like beacon_peer_reachable)."""
+    from ... import metrics
+
+    metrics.PEER_BREAKER_STATE.labels(index=str(index)).set(state)
 
 
 @dataclass
@@ -49,6 +73,10 @@ class BeaconConfig:
     # minority-partition node's lag/missed view honest (the singleton's
     # head is a monotonic max across every in-process node)
     health: object | None = None
+    # quorum repair (ISSUE 12): active pull of missing partials when
+    # the live round is still below threshold past the margin trigger.
+    # Off switches the whole monitor (chaos A/B runs, bench baselines).
+    repair: bool = True
 
 
 def _verify_partial_packet(pub, p: PartialBeaconPacket) -> str | None:
@@ -90,6 +118,18 @@ class Handler(ProtocolService):
         self._run_task: asyncio.Task | None = None
         self._stopped = False
         self._current_round = 0
+        # self-healing state (ISSUE 12): per-peer circuit breakers keyed
+        # by share index, the retry policy for outbound partial sends
+        # (deadline = half the period — a partial that cannot land by
+        # then is better replaced by the repair pull), rounds with a
+        # live repair monitor, and the served-pull rate-cap tracker
+        period = conf.group.period
+        self._breakers: dict[int, PeerBreaker] = {}
+        self._send_policy = RetryPolicy(
+            attempts=SEND_RETRY_ATTEMPTS, base_s=max(0.05, period / 50),
+            cap_s=max(0.25, period / 8), deadline_s=period / 2)
+        self._repairing: set[int] = set()
+        self._repair_served: dict[str, tuple[int, int]] = {}
 
     # ------------------------------------------------------------------ API
     async def start(self) -> None:
@@ -225,6 +265,50 @@ class Handler(ProtocolService):
             self._note_flight(p, "valid", sender=from_addr)
             self.chain.new_valid_partial(from_addr, p)
 
+    async def request_partials(self, from_addr: str, req: PartialRequest
+                               ) -> list[PartialBeaconPacket]:
+        """Serve a quorum-repair PULL from the collector's per-round
+        set (ISSUE 12). DoS posture: only the aggregator's live window
+        is servable, responses carry only ingress-VERIFIED partials
+        (bounded by the group size), and each sender gets at most
+        REPAIR_SERVE_CAP pulls per round before being refused at the
+        door — a refusal is an ANSWER (PeerRejectedError on the wire),
+        so it never reads as unreachability."""
+        last_round = self.chain.last().round
+        from .chain_store import PARTIAL_CACHE_STORE_LIMIT
+
+        # distinguishable reject reasons: the pulling side treats ONLY
+        # "already stored" as the round-exists-elsewhere signal (its
+        # sync leg); a server that is merely lagging must not trigger it
+        if req.round <= last_round:
+            raise TransportError(
+                f"round {req.round} already stored (chain at "
+                f"{last_round})")
+        if req.round > last_round + PARTIAL_CACHE_STORE_LIMIT + 1:
+            raise TransportError(
+                f"round {req.round} beyond the collector window "
+                f"(chain at {last_round})")
+        rd, count = self._repair_served.get(from_addr, (0, 0))
+        if rd != req.round:
+            rd, count = req.round, 0
+        if count >= REPAIR_SERVE_CAP:
+            raise TransportError("repair pull rate-capped")
+        if from_addr not in self._repair_served \
+                and len(self._repair_served) >= 4 * len(self.conf.group):
+            # address-flood bound: evict only STALE-round entries; if
+            # the flood is all live-round spoofed addresses, refuse the
+            # newcomer — never wipe live counts (a capped sender could
+            # otherwise reset its own budget by spraying addresses)
+            self._repair_served = {
+                a: rc for a, rc in self._repair_served.items()
+                if rc[0] == req.round}
+            if len(self._repair_served) >= 4 * len(self.conf.group):
+                raise TransportError("repair pull rate-capped")
+        self._repair_served[from_addr] = (rd, count + 1)
+        exclude = {i for i in req.have if isinstance(i, int)}
+        return self.chain.partials_for(req.round, req.previous_sig,
+                                       exclude)
+
     def sync_chain(self, from_addr: str, req: SyncRequest) -> AsyncIterator[Beacon]:
         return self.chain.sync.sync_chain(from_addr, req)
 
@@ -318,36 +402,204 @@ class Handler(ProtocolService):
                 if node.address() == self.addr:
                     continue
                 spawn(self._send_partial(node, packet))
+            # quorum repair (ISSUE 12): watch the LIVE round only —
+            # catch-up/hurry rounds already ride the breather+sync
+            # machinery, and one monitor per round is the requester-side
+            # rate cap
+            if (self.conf.repair and REPAIR_MARGIN_FRACTION > 0
+                    and round_no == current_round
+                    and round_no not in self._repairing):
+                self._repairing.add(round_no)
+                spawn(self._quorum_repair(round_no, packet))
+
+    def _breaker(self, index: int) -> PeerBreaker:
+        br = self._breakers.get(index)
+        if br is None:
+            # half-open probe cap: at most one probe per round period
+            br = self._breakers[index] = PeerBreaker(
+                index, cooldown_s=max(1.0, self.conf.group.period),
+                on_state=_breaker_gauge)
+        return br
 
     async def _send_partial(self, node, packet: PartialBeaconPacket) -> None:
-        from ...net.transport import PeerRejectedError
-
+        """One peer's share of the round broadcast: retried under the
+        send policy, with EVERY attempt gated by and fed into the
+        peer's circuit breaker — the breaker sees the same outcome
+        classification as ``beacon_peer_reachable`` (note_send), so a
+        partitioned peer trips it within one round's retry budget and
+        subsequent rounds cost one capped probe instead of a storm.
+        note_send counts per ATTEMPT (the metric's documented unit)."""
         g = self.conf.group
-        try:
-            await self._client.partial_beacon(node.identity, packet)
-        except PeerRejectedError as e:
-            # the peer ANSWERED and rejected (stale window while it
-            # catches up, failed verification, ...): reachable — a
-            # lagging-but-alive peer must not read as a partition
-            self._l.debug("beacon_round", packet.round, err=str(e),
-                          to=node.address())
+        br = self._breaker(node.index)
+
+        async def _attempt() -> None:
+            now = self.conf.clock.now()
+            if not br.allow(now):
+                raise BreakerOpenError(node.address())
+            try:
+                await self._client.partial_beacon(node.identity, packet)
+            except PeerRejectedError:
+                # the peer ANSWERED and rejected (stale window while it
+                # catches up, failed verification, ...): reachable — a
+                # lagging-but-alive peer must not read as a partition,
+                # and must never trip the breaker
+                br.record(True, self.conf.clock.now())
+                self.flight.note_send(node.index, True, n=len(g),
+                                      threshold=g.threshold)
+                raise
+            except asyncio.CancelledError:
+                raise
+            except TransportError:
+                # transport failure = the peer is unreachable from
+                # here: feeds the reachability gauge, the
+                # partition-suspect count AND the breaker
+                br.record(False, self.conf.clock.now())
+                self.flight.note_send(node.index, False, n=len(g),
+                                      threshold=g.threshold)
+                raise
+            except Exception:  # peer-side errors on loopback transports
+                br.record(True, self.conf.clock.now())
+                self.flight.note_send(node.index, True, n=len(g),
+                                      threshold=g.threshold)
+                raise
+            br.record(True, self.conf.clock.now())
             self.flight.note_send(node.index, True, n=len(g),
                                   threshold=g.threshold)
+
+        try:
+            await retry(_attempt, op="partial", policy=self._send_policy,
+                        clock=self.conf.clock,
+                        retry_on=(TransportError,),
+                        no_retry=(PeerRejectedError,))
+        except BreakerOpenError:
+            # skipped: no send happened, nothing to classify (the trip
+            # itself already flipped reachability + the breaker gauge)
             return
+        except PeerRejectedError as e:
+            self._l.debug("beacon_round", packet.round, err=str(e),
+                          to=node.address())
         except TransportError as e:
             self._l.debug("beacon_round", packet.round, err_request=str(e),
                           to=node.address())
-            # transport failure = the peer is unreachable from here:
-            # feeds the reachability gauge + partition-suspect count
-            self.flight.note_send(node.index, False, n=len(g),
-                                  threshold=g.threshold)
-            return
         except asyncio.CancelledError:
             raise
-        except Exception as e:  # peer-side errors on loopback transports
-            self._l.debug("beacon_round", packet.round, err=str(e), to=node.address())
-            self.flight.note_send(node.index, True, n=len(g),
-                                  threshold=g.threshold)
-            return
-        self.flight.note_send(node.index, True, n=len(g),
-                              threshold=g.threshold)
+        except Exception as e:
+            self._l.debug("beacon_round", packet.round, err=str(e),
+                          to=node.address())
+
+    async def _quorum_repair(self, round_no: int,
+                             packet: PartialBeaconPacket) -> None:
+        """Quorum repair (ISSUE 12): once the live round's margin has
+        shrunk below ``REPAIR_MARGIN_FRACTION`` of the period with
+        valid partials still below threshold, actively close the gap —
+        re-push our own partial to unreached peers and PULL missing
+        partials from peers that hold them. The trigger reads the
+        collector's VERIFIED set only (never flight events, whose
+        rejected entries carry unverified index claims); pulls are
+        single-shot per peer per round (the multi-peer sweep is the
+        retry), and every pulled packet re-enters through the normal
+        ingress verification."""
+        g = self.conf.group
+        try:
+            await self.conf.clock.sleep(
+                g.period * (1.0 - REPAIR_MARGIN_FRACTION))
+            if self._stopped or self.chain.last().round >= round_no:
+                return
+            thr = g.threshold
+            have = self.chain.partial_indices(round_no,
+                                              packet.previous_sig)
+            if len(have) >= thr:
+                return
+            self._l.debug("quorum_repair", round_no, have=len(have),
+                          threshold=thr)
+            # push side: our own partial again, to peers whose last
+            # send failed (breaker-gated inside _send_partial)
+            reach = self.flight.reachability()
+            for node in g.nodes:
+                if node.address() != self.addr \
+                        and reach.get(str(node.index)) is False:
+                    spawn(self._send_partial(node, packet))
+            # pull side: peers whose own partial we are missing first —
+            # they hold at least their own contribution
+            pulled = 0
+            peer_past_round = False
+            order = sorted(
+                (nd for nd in g.nodes if nd.address() != self.addr),
+                key=lambda nd: (nd.index in have, nd.index))
+            for node in order:
+                if len(have) >= thr:
+                    break
+                if not self._breaker(node.index).allow(
+                        self.conf.clock.now()):
+                    continue
+                req = PartialRequest(round=round_no,
+                                     previous_sig=packet.previous_sig,
+                                     have=tuple(sorted(have)))
+                try:
+                    served = await self._client.request_partials(
+                        node.identity, req)
+                except asyncio.CancelledError:
+                    raise
+                except PeerRejectedError as e:
+                    # an ANSWERED refusal: the peer is reachable. Only
+                    # the "already stored" refusal means the round
+                    # exists elsewhere (it aggregated + flushed its
+                    # collector — e.g. only OUR inbound is cut) and the
+                    # sync leg below can recover it; a lagging peer's
+                    # window refusal or a rate-cap must not fake that
+                    self._breaker(node.index).record(
+                        True, self.conf.clock.now())
+                    if "already stored" in str(e):
+                        peer_past_round = True
+                    continue
+                except TransportError:
+                    self._breaker(node.index).record(
+                        False, self.conf.clock.now())
+                    continue
+                except Exception:  # peers without the RPC, local errors
+                    # something answered (or failed locally) — record
+                    # the granted slot as answered so a half-open probe
+                    # consumed by this pull can never wedge the breaker
+                    self._breaker(node.index).record(
+                        True, self.conf.clock.now())
+                    continue
+                self._breaker(node.index).record(
+                    True, self.conf.clock.now())
+                for p in served[: len(g)]:
+                    try:
+                        idx = tbls.index_of(p.partial_sig)
+                    except ValueError:
+                        continue
+                    if idx in have:
+                        continue
+                    try:
+                        await self.process_partial_beacon(
+                            node.address(), p)
+                    except TransportError:
+                        continue  # dupes/garbage: counted by ingress
+                    have.add(idx)
+                    pulled += 1
+            if len(have) >= thr:
+                outcome = "recovered"
+            elif peer_past_round:
+                # the round cannot be re-collected here but it EXISTS
+                # on a reachable peer: fetch the stored beacon now
+                # instead of waiting for the next tick's gap detection
+                # (a whole period later)
+                outcome = "synced"
+                peers = [nd.identity for nd in g.nodes
+                         if nd.address() != self.addr]
+                spawn(self.chain.run_sync(round_no, peers))
+            else:
+                outcome = "failed"
+            self.flight.note_repair(
+                round_no, outcome=outcome, pulled=pulled,
+                now=self.conf.clock.now(), period=g.period,
+                genesis=g.genesis_time)
+            if outcome != "failed":
+                self._l.info("quorum_repair", outcome, round=round_no,
+                             pulled=pulled)
+        except asyncio.CancelledError:
+            raise
+        finally:
+            self._repairing.discard(round_no)

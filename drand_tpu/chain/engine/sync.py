@@ -14,7 +14,9 @@ from ...crypto import batch
 from ...net.packets import SyncRequest
 from ...net.transport import ProtocolClient, TransportError
 from ...obs.trace import TRACER
+from ...utils.clock import Clock
 from ...utils.logging import KVLogger
+from ...utils.retry import RetryPolicy, retry
 from ..beacon import Beacon
 from ..info import Info
 from ..store import CallbackStore, StoreError
@@ -23,6 +25,11 @@ from ..store import CallbackStore, StoreError
 # verifies a whole chunk in one multi-pairing call (client/verify.go:146-163
 # made parallel). Chunk boundaries never change semantics — only batch size.
 SYNC_CHUNK = int(os.environ.get("DRAND_TPU_SYNC_CHUNK", "64"))
+# full passes over the upstream list per follow (ISSUE 12): a follow no
+# longer dies with one bad pass — it backs off under the shared retry
+# policy (injectable-clock sleeps) and tries the whole list again,
+# resuming from the stored checkpoint
+SYNC_PASSES = int(os.environ.get("DRAND_TPU_SYNC_RETRIES", "3"))
 
 
 def _verify_chunk_size() -> int:
@@ -81,15 +88,23 @@ async def _chunks(stream: AsyncIterator[Beacon], size: int):
 
 class Syncer:
     """Client side: Follow shuffles peers and streams beacons from last+1,
-    verifying each link. Server side: SyncChain replays the cursor then
-    streams live beacons via a store callback."""
+    verifying each link, with multi-upstream failover: a pass over the
+    peer list that fails backs off (shared retry policy, injectable
+    clock) and re-runs, and every re-attempt resumes from the stored
+    checkpoint — ``_try_node`` streams from ``store.last() + 1``, so a
+    span verified+stored before a mid-chunk upstream death is NEVER
+    re-fetched or re-verified. Server side: SyncChain replays the
+    cursor then streams live beacons via a store callback."""
 
     def __init__(self, logger: KVLogger, store: CallbackStore, info: Info,
-                 client: ProtocolClient):
+                 client: ProtocolClient, clock: Clock | None = None):
         self._l = logger
         self._store = store
         self._info = info
         self._client = client
+        self._clock = clock
+        self._policy = RetryPolicy(attempts=max(1, SYNC_PASSES),
+                                   base_s=0.2, cap_s=5.0)
         self._following = False
         self._lock = asyncio.Lock()
 
@@ -111,13 +126,21 @@ class Syncer:
 
         self._progress_t0 = _time.perf_counter()
         self._progress_done = 0
-        try:
+
+        async def _one_pass() -> bool:
             order = list(peers)
             random.shuffle(order)
             for peer in order:
                 if await self._try_node(up_to, peer):
                     return True
             self._l.debug("syncer", "tried_all_nodes")
+            raise TransportError("sync: tried all upstreams")
+
+        try:
+            return await retry(_one_pass, op="sync", policy=self._policy,
+                               clock=self._clock,
+                               retry_on=(TransportError,))
+        except TransportError:
             return False
         finally:
             self._following = False

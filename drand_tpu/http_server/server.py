@@ -63,6 +63,9 @@ class PublicServer:
         self._next_round_event = asyncio.Event()
         self._watch_task: asyncio.Task | None = None
         self._chain_tag: bytes | None = None
+        # last successfully fetched chain info: the stale-serving path
+        # computes the X-Drand-Stale lag from it after the upstream dies
+        self._info_cache = None
         self.app = web.Application(middlewares=[self._instrument])
         self.app.add_routes([
             web.get("/public/latest", self._handle_latest),
@@ -167,6 +170,13 @@ class PublicServer:
             return web.json_response({"error": str(e)}, status=502)
         return web.Response(body=body, content_type="text/plain")
 
+    async def _get_info(self):
+        """Chain info with the last-success cache refreshed (the
+        stale-serving lag source). Raises ClientError like info()."""
+        info = await self._client.info()
+        self._info_cache = info
+        return info
+
     async def _result_response(self, r: Result) -> web.Response:
         """Beacon JSON + the round-correlation id as an HTTP header, so a
         consumer can join the response to /debug/trace and the KV logs."""
@@ -175,7 +185,7 @@ class PublicServer:
             from ..obs import trace as obs_trace
 
             if self._chain_tag is None:
-                self._chain_tag = (await self._client.info()).genesis_seed
+                self._chain_tag = (await self._get_info()).genesis_seed
             resp.headers[obs_trace.TRACEPARENT_HEADER] = \
                 obs_trace.make_traceparent(
                     obs_trace.round_trace_id(r.round, self._chain_tag))
@@ -187,8 +197,35 @@ class PublicServer:
         try:
             r = await self._client.get(0)
         except ClientError as e:
-            return web.json_response({"error": str(e)}, status=404)
+            return await self._stale_or_error(e)
         return await self._result_response(r)
+
+    async def _stale_or_error(self, err: ClientError) -> web.Response:
+        """Degraded-mode serving (ISSUE 12): when the upstream is lost
+        but a beacon was ever seen, serve the LAST-KNOWN beacon as a
+        non-cacheable 200 with an explicit ``X-Drand-Stale: <lag>``
+        header (lag in rounds behind the schedule, computed from the
+        cached chain info; -1 when no info was ever fetched) instead of
+        a 5xx/404 — a consumer that can tolerate staleness keeps
+        working, one that cannot sees the header and knows. no-store
+        keeps CDNs from pinning the stale answer past the outage."""
+        if self._latest is None:
+            return web.json_response({"error": str(err)}, status=404)
+        from .. import metrics
+
+        lag = -1
+        info = self._info_cache
+        if info is not None:
+            expected = time_math.current_round(
+                int(self._clock.now()), info.period, info.genesis_time)
+            lag = max(0, expected - self._latest.round)
+        resp = await self._result_response(self._latest)
+        resp.headers["X-Drand-Stale"] = str(lag)
+        resp.headers["Cache-Control"] = "no-store"
+        metrics.RELAY_STALE_SERVED.inc()
+        self._l.warn("http", "serving_stale", lag_rounds=lag,
+                     round=self._latest.round)
+        return resp
 
     async def _handle_round(self, request: web.Request) -> web.Response:
         try:
@@ -203,7 +240,7 @@ class PublicServer:
         # historical round 404s immediately — blocking the watch timeout
         # for arbitrary absent rounds would be free connection-holding
         try:
-            info = await self._client.info()
+            info = await self._get_info()
         except ClientError as e:
             return web.json_response({"error": str(e)}, status=503)
         expected = time_math.current_round(
@@ -223,7 +260,7 @@ class PublicServer:
 
     async def _handle_info(self, request: web.Request) -> web.Response:
         try:
-            info = await self._client.info()
+            info = await self._get_info()
         except ClientError as e:
             return web.json_response({"error": str(e)}, status=503)
         return web.json_response({
@@ -237,7 +274,7 @@ class PublicServer:
     async def _handle_health(self, request: web.Request) -> web.Response:
         """Current vs expected round (http/server.go:351)."""
         try:
-            info = await self._client.info()
+            info = await self._get_info()
         except ClientError as e:
             return web.json_response({"error": str(e)}, status=503)
         expected = time_math.current_round(
@@ -265,7 +302,7 @@ class PublicServer:
         (pre-DKG / relay origin down)."""
         from ..obs.health import HEALTH
 
-        info = await self._client.info()
+        info = await self._get_info()
         head = await self._head_round()
         HEALTH.observe_chain(self._clock.now(), info.period,
                              info.genesis_time, head)

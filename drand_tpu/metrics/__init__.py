@@ -99,6 +99,25 @@ sends and the /healthz pull path):
   chain_sync_stalled                   [group]   1 while the chain lags
       beyond the readiness bound with no catch-up making progress
       (pull-model: re-evaluated by /healthz probes and scrapes)
+Self-healing set (utils/retry.py policy, net/transport.py breakers,
+handler quorum repair, http_server stale serving — ISSUE 12: the
+active-recovery tier the ISSUE-11 fault oracle proved was missing):
+  net_retry_attempts_total{op,outcome} [group]   every retry-policy
+      attempt by call-site op (partial | sync | repair | control |
+      gossip | timelock) and outcome (ok | retry | exhausted |
+      rejected — rejected = classified non-retryable, e.g. the peer
+      answered with a reject)
+  beacon_peer_breaker_state{index}     [group]   per-peer circuit
+      breaker state (0 = closed, 1 = half-open, 2 = open); fed by the
+      same outbound-send outcomes as beacon_peer_reachable, index
+      cardinality bounded by the group size
+  beacon_partial_repairs_total{outcome} [group]  quorum-repair
+      operations by outcome (recovered = the pull reached threshold
+      inside the round's period; synced = peers already stored the
+      round, fetched via sync instead; failed = still below threshold)
+  relay_stale_served_total             [http]    /public/latest
+      responses served from the last-known beacon with the
+      X-Drand-Stale header because the upstream was unreachable
 Engine introspection (ISSUE 6):
   engine_compile_seconds{op}           [private] FIRST dispatch of each
       (op, path, batch-bucket) device shape — the jit compile +
@@ -340,6 +359,33 @@ SYNC_STALLED = Gauge(
     "1 while the chain head lags beyond the readiness bound and no "
     "catch-up is making progress (re-evaluated by /healthz and scrapes)",
     registry=GROUP_REGISTRY)
+
+# ---- self-healing (utils/retry.py, net/transport.py, handler repair) ------
+NET_RETRY_ATTEMPTS = Counter(
+    "net_retry_attempts_total",
+    "Retry-policy attempts by call-site op (partial|sync|repair|"
+    "control|gossip|timelock) and outcome (ok = attempt succeeded; "
+    "retry = failed with a backoff sleep following; exhausted = failed "
+    "with no budget left; rejected = classified non-retryable)",
+    ["op", "outcome"], registry=GROUP_REGISTRY)
+PEER_BREAKER_STATE = Gauge(
+    "beacon_peer_breaker_state",
+    "Per-peer circuit breaker state by share index "
+    "(0 = closed, 1 = half-open, 2 = open) — open means outbound "
+    "sends to that member are skipped until the next capped probe",
+    ["index"], registry=GROUP_REGISTRY)
+PARTIAL_REPAIRS = Counter(
+    "beacon_partial_repairs_total",
+    "Quorum-repair operations by outcome (recovered = the pull "
+    "reached the threshold inside the round's period; synced = peers "
+    "had already stored the round, the beacon is fetched via sync "
+    "instead; failed = the round stayed below threshold)",
+    ["outcome"], registry=GROUP_REGISTRY)
+RELAY_STALE_SERVED = Counter(
+    "relay_stale_served_total",
+    "/public/latest responses served from the last-known beacon with "
+    "the X-Drand-Stale header because the upstream was unreachable",
+    registry=HTTP_REGISTRY)
 
 # ---- OTLP export (obs/export.py) ------------------------------------------
 OTLP_EXPORT_ROUNDS = Counter(

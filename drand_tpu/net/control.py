@@ -341,11 +341,21 @@ class ControlClient:
         self._channel: grpc.aio.Channel | None = None
 
     async def _call(self, method: str, req: dict, timeout: float = 120.0) -> dict:
+        from ..utils.retry import RetryPolicy, retry
+
         if self._channel is None:
             self._channel = grpc.aio.insecure_channel(self._target)
         fn = self._channel.unary_unary(f"/{SERVICE}/{method}")
         try:
-            raw = await fn(json.dumps(req).encode(), timeout=timeout)
+            # control dials retry UNAVAILABLE only (ISSUE 12): the CLI
+            # racing a daemon that is still binding its control port is
+            # the classic flake; an ANSWERED error must surface verbatim
+            raw = await retry(
+                lambda: fn(json.dumps(req).encode(), timeout=timeout),
+                op="control",
+                policy=RetryPolicy(attempts=3, base_s=0.2, cap_s=1.0),
+                retry_on=(grpc.aio.AioRpcError,),
+                giveup=lambda e: e.code() != grpc.StatusCode.UNAVAILABLE)
         except grpc.aio.AioRpcError as e:
             raise RuntimeError(
                 f"control {method}: {e.code().name} {e.details()}") from e

@@ -41,8 +41,8 @@ _REJECT_CODES = (grpc.StatusCode.INVALID_ARGUMENT,
 SERVICE = "drand.Protocol"
 PUBLIC_SERVICE = "drand.Public"  # protobuf interop surface (api.proto)
 _UNARY = ("GetIdentity", "SignalDKGParticipant", "PushDKGInfo",
-          "BroadcastDKG", "PartialBeacon", "ChainInfo", "PrivateRand",
-          "Metrics", "PublicRand")
+          "BroadcastDKG", "PartialBeacon", "RequestPartials", "ChainInfo",
+          "PrivateRand", "Metrics", "PublicRand")
 
 DEFAULT_TIMEOUT = 5.0
 SYNC_TIMEOUT = 600.0
@@ -135,6 +135,7 @@ class GrpcGateway:
             "PushDKGInfo": self._push_group,
             "BroadcastDKG": self._broadcast,
             "PartialBeacon": self._partial,
+            "RequestPartials": self._request_partials,
             "ChainInfo": self._chain_info,
             "PrivateRand": self._private_rand,
             "Metrics": self._peer_metrics,
@@ -300,6 +301,12 @@ class GrpcGateway:
     async def _partial(self, msg, from_addr) -> bytes:
         await self._svc.process_partial_beacon(from_addr, msg)
         return b"{}"
+
+    async def _request_partials(self, msg, from_addr) -> bytes:
+        from .packets import PartialBatch
+
+        served = await self._svc.request_partials(from_addr, msg)
+        return wire.encode(PartialBatch(packets=tuple(served)))
 
     async def _chain_info(self, msg, from_addr) -> bytes:
         info = await self._svc.chain_info(from_addr)
@@ -533,6 +540,11 @@ class GrpcClient(ProtocolClient):
     # ------------------------------------------------------ ProtocolClient
     async def partial_beacon(self, peer, packet: PartialBeaconPacket) -> None:
         await self._call(peer, "PartialBeacon", packet)
+
+    async def request_partials(self, peer, req) -> list[PartialBeaconPacket]:
+        raw = await self._call(peer, "RequestPartials", req)
+        msg, _ = wire.decode(raw)
+        return list(msg.packets)
 
     async def sync_chain(self, peer, req: SyncRequest) -> AsyncIterator[Beacon]:
         ch, target = self._channel(peer)

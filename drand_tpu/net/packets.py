@@ -30,6 +30,25 @@ class SyncRequest:
 
 
 @dataclass(frozen=True)
+class PartialRequest:
+    """Quorum-repair PULL request (ISSUE 12): give me the partials you
+    collected for ``round`` that I do not already hold. ``have`` is the
+    requester's share-index set — the server subtracts it so a repair
+    round-trip never re-ships what the requester has."""
+
+    round: int
+    previous_sig: bytes
+    have: tuple[int, ...] = ()
+
+
+@dataclass(frozen=True)
+class PartialBatch:
+    """Quorum-repair PULL response: the served partial packets."""
+
+    packets: tuple[PartialBeaconPacket, ...] = ()
+
+
+@dataclass(frozen=True)
 class SignalDKGPacket:
     """SignalDKGParticipant payload (protocol.proto PeerIdentity + secret):
     a participant announces itself to the setup leader."""

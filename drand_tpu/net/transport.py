@@ -11,10 +11,11 @@ Reference: net/client.go:30 (ProtocolClient), net/gateway.go:44 (Service).
 from __future__ import annotations
 
 import asyncio
+import os
 import random
 from typing import AsyncIterator, Protocol
 
-from .packets import PartialBeaconPacket, SyncRequest
+from .packets import PartialBeaconPacket, PartialRequest, SyncRequest
 from ..chain.beacon import Beacon
 
 
@@ -35,10 +36,117 @@ class PeerRejectedError(TransportError):
     into a phantom partition suspect."""
 
 
+class BreakerOpenError(Exception):
+    """An outbound call was SKIPPED because the peer's circuit breaker
+    is open. Deliberately NOT a TransportError: the retry policy must
+    never classify it as a transport outcome (no send happened), and a
+    retry loop whose breaker opens mid-flight aborts immediately
+    instead of burning its remaining attempts."""
+
+
+# breaker tuning (ISSUE 12): trip after this many CONSECUTIVE transport
+# failures; the half-open probe rate is set per-handler (one probe per
+# round period by default)
+BREAKER_THRESHOLD = int(os.environ.get("DRAND_TPU_BREAKER_THRESHOLD", "3"))
+
+BREAKER_CLOSED = 0
+BREAKER_HALF_OPEN = 1
+BREAKER_OPEN = 2
+
+
+class PeerBreaker:
+    """Per-peer circuit breaker for the outbound beacon plane.
+
+    State machine: CLOSED counts consecutive transport failures and
+    trips OPEN at ``threshold``; OPEN denies all sends until
+    ``cooldown_s`` elapses, then admits exactly ONE probe (HALF_OPEN —
+    concurrent callers keep being denied, so probes are rate-capped by
+    construction even when a round fans out many sends at once); a
+    successful probe closes the breaker, a failed one re-opens it for
+    another cooldown.
+
+    Classification contract (the PeerRejectedError rule): only
+    TRANSPORT failures trip the breaker — a peer that answered with a
+    rejection is reachable and records ``ok=True``. Feeding rejects in
+    would open breakers against every lagging-but-alive peer and
+    partition the group from the inside.
+
+    Single-threaded by design: driven from the event loop by the
+    handler's send path (the same path that feeds
+    ``beacon_peer_reachable``); no lock needed. State transitions are
+    exported via ``on_state`` (the ``beacon_peer_breaker_state{index}``
+    gauge)."""
+
+    def __init__(self, index: int, threshold: int = BREAKER_THRESHOLD,
+                 cooldown_s: float = 10.0, on_state=None):
+        self.index = index
+        self.threshold = max(1, threshold)
+        self.cooldown_s = cooldown_s
+        self.state = BREAKER_CLOSED
+        self._fails = 0
+        self._next_probe = 0.0
+        self._on_state = on_state
+        if on_state is not None:
+            on_state(index, BREAKER_CLOSED)
+
+    def _set(self, state: int) -> None:
+        if state != self.state:
+            self.state = state
+            if self._on_state is not None:
+                self._on_state(self.index, state)
+
+    def allow(self, now: float) -> bool:
+        """May a send go out right now? OPEN past the cooldown admits
+        one probe and moves to HALF_OPEN; the next probe slot is
+        reserved immediately, so even a probe whose outcome never lands
+        (wedged transport) cannot exceed the capped rate."""
+        if self.state == BREAKER_CLOSED:
+            return True
+        if now >= self._next_probe:
+            # OPEN past the cooldown — or HALF_OPEN whose reserved slot
+            # EXPIRED: a probe whose outcome never landed (caller died
+            # between allow() and record(), wedged transport) must not
+            # blacklist the peer forever, so the slot becomes grantable
+            # again after a full cooldown
+            self._set(BREAKER_HALF_OPEN)
+            self._next_probe = now + self.cooldown_s
+            return True
+        return False
+
+    def record(self, ok: bool, now: float) -> None:
+        """One send outcome. ``ok`` covers success AND answered-with-
+        reject (see the classification contract)."""
+        if ok:
+            self._fails = 0
+            self._set(BREAKER_CLOSED)
+            return
+        self._fails += 1
+        if self.state == BREAKER_HALF_OPEN:
+            # the next probe slot was already reserved when allow()
+            # granted this one — a probe whose FAILURE lands late (slow
+            # link, retry backoff) must not push the slot past the next
+            # round's sends, or probes drift into the mid-round dead
+            # zone and a healed partition takes an extra round to notice
+            self._set(BREAKER_OPEN)
+        elif self.state == BREAKER_CLOSED \
+                and self._fails >= self.threshold:
+            self._set(BREAKER_OPEN)
+            self._next_probe = now + self.cooldown_s
+        # failures reported while already OPEN (in-flight sends that
+        # passed allow() before a sibling tripped the breaker) never
+        # move the reserved probe slot
+
+
 class ProtocolClient:
     """Outbound node->node calls (reference net/client.go:30-49)."""
 
     async def partial_beacon(self, peer, packet: PartialBeaconPacket) -> None:
+        raise NotImplementedError
+
+    async def request_partials(self, peer, req: PartialRequest
+                               ) -> list[PartialBeaconPacket]:
+        """Quorum repair PULL (ISSUE 12): the peer's collected partials
+        for one round, minus the indices the caller already holds."""
         raise NotImplementedError
 
     async def sync_chain(self, peer, req: SyncRequest) -> AsyncIterator[Beacon]:
@@ -77,6 +185,10 @@ class ProtocolService:
     (reference protobuf/drand/protocol.proto:16-33)."""
 
     async def process_partial_beacon(self, from_addr: str, packet: PartialBeaconPacket) -> None:
+        raise NotImplementedError
+
+    async def request_partials(self, from_addr: str, req: PartialRequest
+                               ) -> list[PartialBeaconPacket]:
         raise NotImplementedError
 
     def sync_chain(self, from_addr: str, req: SyncRequest) -> AsyncIterator[Beacon]:
@@ -134,6 +246,9 @@ class LocalNetwork:
     def allow(self, src: str, dst: str) -> None:
         self._deny.discard((src, dst))
 
+    def allow_all(self) -> None:
+        self._deny.clear()
+
     def set_down(self, address: str, down: bool = True) -> None:
         (self._down.add if down else self._down.discard)(address)
 
@@ -165,6 +280,19 @@ class LocalClient(ProtocolClient):
         except TransportError as e:
             # _target already raised for unreachability; an error from
             # the service itself is the PEER's verdict — it answered
+            raise PeerRejectedError(str(e)) from e
+
+    async def request_partials(self, peer, req: PartialRequest
+                               ) -> list[PartialBeaconPacket]:
+        svc = self._net._target(self._addr, peer)
+        try:
+            return await svc.request_partials(self._addr, req)
+        except PeerRejectedError:
+            raise
+        except TransportError as e:
+            # _target already raised for unreachability; an error from
+            # the service itself is the PEER's verdict — it answered
+            # (the gRPC transport maps FAILED_PRECONDITION the same way)
             raise PeerRejectedError(str(e)) from e
 
     async def sync_chain(self, peer, req: SyncRequest) -> AsyncIterator[Beacon]:

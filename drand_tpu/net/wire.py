@@ -24,7 +24,8 @@ from ..dkg.packets import (
     ResponseBundle,
 )
 from ..key.keys import Identity
-from .packets import GroupPacket, PartialBeaconPacket, SignalDKGPacket, SyncRequest
+from .packets import (GroupPacket, PartialBatch, PartialBeaconPacket,
+                      PartialRequest, SignalDKGPacket, SyncRequest)
 
 
 class WireError(Exception):
@@ -79,6 +80,18 @@ _codec("partial_beacon")((
 _codec("sync_request")((
     lambda r: {"from_round": r.from_round},
     lambda d: SyncRequest(from_round=int(d["from_round"]))))
+
+_codec("partial_request")((
+    lambda r: {"round": r.round, "prev": _hex(r.previous_sig),
+               "have": list(r.have)},
+    lambda d: PartialRequest(round=int(d["round"]),
+                             previous_sig=_unhex(d["prev"]),
+                             have=tuple(int(i) for i in d.get("have", [])))))
+
+_codec("partial_batch")((
+    lambda b: {"packets": [_ENC["partial_beacon"](p) for p in b.packets]},
+    lambda d: PartialBatch(packets=tuple(
+        _DEC["partial_beacon"](p) for p in d.get("packets", [])))))
 
 _codec("blob")((
     lambda b: {"data": _hex(bytes(b))},
@@ -163,6 +176,8 @@ _TYPE_OF = {
     Blob: "blob",
     PartialBeaconPacket: "partial_beacon",
     SyncRequest: "sync_request",
+    PartialRequest: "partial_request",
+    PartialBatch: "partial_batch",
     Beacon: "beacon",
     Info: "info",
     Identity: "identity",

@@ -120,6 +120,18 @@ def _send_counter(index: int, ok: bool):
     return metrics.PEER_SENDS.labels(outcome="failed", index=str(index))
 
 
+def _repair_counter(outcome: str):
+    """Branch-literal outcome labels for beacon_partial_repairs_total
+    (the check_metrics KNOWN_LABEL_VALUES enum rule)."""
+    from .. import metrics
+
+    if outcome == "recovered":
+        return metrics.PARTIAL_REPAIRS.labels(outcome="recovered")
+    if outcome == "synced":
+        return metrics.PARTIAL_REPAIRS.labels(outcome="synced")
+    return metrics.PARTIAL_REPAIRS.labels(outcome="failed")
+
+
 class FlightRecorder:
     """Bounded per-round ring of partial-arrival events + aggregation
     milestones, plus cumulative per-peer counters.
@@ -294,6 +306,29 @@ class FlightRecorder:
         (JSON-keyed; absent index = never sent to)."""
         with self._lock:
             return {str(i): up for i, up in sorted(self._reach.items())}
+
+    def note_repair(self, round_no: int, *, outcome: str, pulled: int,
+                    now: float, period: int, genesis: int) -> None:
+        """One quorum-repair operation finished (ISSUE 12): the handler
+        pulled missing partials because the round was still below
+        threshold past the margin trigger. ``outcome`` is the enum
+        recovered (pulls reached threshold) | synced (peers were
+        already past the round; the beacon is being fetched instead) |
+        failed. Lands as a ``repair`` milestone on the round's flight
+        record (when one exists — repair never CREATES ring entries,
+        same DoS rule as rejects) and on
+        ``beacon_partial_repairs_total{outcome}``."""
+        offset = self._offset(now, round_no, period, genesis)
+        with self._lock:
+            rec = self._get(round_no, create=False, now=now, period=period,
+                            genesis=genesis)
+            if rec is not None:
+                self._append(rec, "milestones",
+                             {"name": "repair", "t": now,
+                              "offset_s": round(offset, 6),
+                              "pulled": pulled,
+                              "outcome": outcome}, self.max_events)
+        _repair_counter(outcome).inc()
 
     def note_quorum(self, round_no: int, *, have: int, threshold: int,
                     now: float, period: int, genesis: int,

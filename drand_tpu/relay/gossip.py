@@ -382,6 +382,8 @@ class GossipNode(Client):
                 spawn(self._forward(addr, st, raw))
 
     async def _forward(self, addr: str, st: _PeerState, raw: bytes) -> None:
+        from ..utils.retry import RetryPolicy, retry
+
         ch = st.channel
         if ch is None:
             return
@@ -389,8 +391,21 @@ class GossipNode(Client):
         # round-correlation id rides the mesh hop as gRPC metadata
         md = obs_trace.outbound_metadata()
         try:
-            await ch.unary_unary(f"/{SERVICE}/Publish")(raw, timeout=5.0,
-                                                        metadata=md)
+            # a gossip hop retries transient connectivity once before
+            # charging the peer's fail score (ISSUE 12); answered
+            # rejections give up immediately — retrying a remote's own
+            # cooloff reject would look like a flood to it. Backoff on
+            # the system clock deliberately: the gossip validation
+            # clock is a fake in tests and nobody advances it here.
+            await retry(
+                lambda: ch.unary_unary(f"/{SERVICE}/Publish")(
+                    raw, timeout=5.0, metadata=md),
+                op="gossip",
+                policy=RetryPolicy(attempts=2, base_s=0.05, cap_s=0.25),
+                retry_on=(grpc.aio.AioRpcError,),
+                giveup=lambda e: e.code() in (
+                    grpc.StatusCode.PERMISSION_DENIED,
+                    grpc.StatusCode.INVALID_ARGUMENT))
             st.fails = 0
         except grpc.aio.AioRpcError as e:
             self._l.debug("gossip", "forward_failed", to=addr,

@@ -380,6 +380,9 @@ class FaultEvent:
 
     - ``partition`` (groups=[[idx,...],...]) / ``heal``
     - ``link_all`` (policy=LinkPolicy|None) / ``link`` (src,dst,policy)
+    - ``deny`` (src,dst) / ``allow`` (src,dst) — ONE-directional edge
+      cut (asymmetric partitions: src's calls to dst fail while dst's
+      calls to src still go through)
     - ``skew`` (node, seconds)
     - ``crash`` (nodes=[...]) / ``restart`` (nodes=[...])
     - ``byzantine`` (node, kind, frame_index=None)
@@ -399,7 +402,11 @@ class ChaosBeaconNetwork:
 
     def __init__(self, n: int, t: int, period: int = 4,
                  genesis_delay: int = 4, seed: bytes = b"chaos-dkg",
-                 net_seed: int = 7, log_level: str = "none"):
+                 net_seed: int = 7, log_level: str = "none",
+                 repair: bool = True):
+        # repair=False runs the pre-ISSUE-12 passive plane (A/B
+        # baselines: bench chaos_soak's with/without-repair comparison)
+        self.repair = repair
         self.base_clock = FakeClock()
         self.genesis_time = int(self.base_clock.now()) + genesis_delay
         self.group, self.pairs, self.shares = make_test_group(
@@ -430,7 +437,8 @@ class ChaosBeaconNetwork:
         conf = BeaconConfig(
             public=self.group.nodes[i], share=self.shares[i],
             group=self.group, clock=self.clocks[i],
-            flight=self.flights[i], health=self.healths[i])
+            flight=self.flights[i], health=self.healths[i],
+            repair=self.repair)
         h = Handler(client=self.network.client_for(self.addr(i)),
                     store=self.stores[i], conf=conf,
                     logger=self._logger.named(f"n{i}"))
@@ -613,11 +621,16 @@ class ChaosBeaconNetwork:
         elif ev.action == "heal":
             self.heal()
             self.network.clear_links()
+            self.network.allow_all()
         elif ev.action == "link_all":
             self.set_link_all(kw.get("policy"))
         elif ev.action == "link":
             self.network.set_link(self.addr(kw["src"]),
                                   self.addr(kw["dst"]), kw.get("policy"))
+        elif ev.action == "deny":
+            self.network.deny(self.addr(kw["src"]), self.addr(kw["dst"]))
+        elif ev.action == "allow":
+            self.network.allow(self.addr(kw["src"]), self.addr(kw["dst"]))
         elif ev.action == "skew":
             self.skew(kw["node"], kw["seconds"])
         elif ev.action == "crash":

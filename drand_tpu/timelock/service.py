@@ -41,7 +41,13 @@ from ..client.interface import Client, ClientError, Result, \
 from ..crypto import batch
 from ..utils.aio import spawn
 from ..utils.logging import KVLogger, default_logger
+from ..utils.retry import RetryPolicy, retry
 from .vault import TimelockVault
+
+# upstream round fetches inside the sweep retry under the shared policy
+# (ISSUE 12): a transient relay/origin blip must not leave a whole
+# round's ciphertexts pending until the NEXT boundary
+_FETCH_POLICY = RetryPolicy(attempts=3, base_s=0.2, cap_s=2.0)
 
 # submission caps: W (the masked payload) and the global pending backlog
 MAX_PLAINTEXT = int(os.environ.get("DRAND_TPU_TIMELOCK_MAX_BYTES",
@@ -251,7 +257,10 @@ class TimelockService:
                     r = result
                 else:
                     try:
-                        r = await self._client.get(rd)
+                        r = await retry(
+                            lambda rd=rd: self._client.get(rd),
+                            op="timelock", policy=_FETCH_POLICY,
+                            retry_on=(ClientError,))
                     except ClientError as e:
                         self._l.warn("timelock", "round_fetch_failed",
                                      round=rd, err=str(e))

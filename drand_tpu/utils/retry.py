@@ -1,0 +1,132 @@
+"""ONE deadline-aware retry policy for the beacon plane (ISSUE 12).
+
+Every network edge that retries in this codebase goes through this
+module: partial-beacon sends (chain/engine/handler.py), sync chunk
+fetches (chain/engine/sync.py follow passes), control and gossip dials
+(net/control.py, relay/gossip.py), and the timelock sweep's upstream
+round fetch (timelock/service.py). One policy object means one backoff
+shape, one metric, and one determinism rule instead of five hand-rolled
+loops that each invent their own.
+
+Backoff is **decorrelated jitter** (the AWS architecture-blog variant):
+each sleep is drawn uniformly from ``[base, 3 * previous_sleep]`` and
+capped, which decorrelates retry storms across peers better than
+plain exponential-with-jitter while keeping the first retry fast.
+
+Determinism: sleeps go through an **injectable Clock**
+(:mod:`drand_tpu.utils.clock`), so a FakeClock chaos run steps retry
+wake-ups exactly like every other timer — wall-clock never leaks into
+a scheduled fault's margin math. The jitter source is injectable too
+(``rng=random.Random(seed)``) for exact-value tests. The analyzer
+enforces the other half of this contract: a raw ``asyncio.sleep``
+inside a retry loop in net/, chain/ or timelock/ is a medium
+``loopblock:retry-sleep`` finding.
+
+Observability: every attempt lands on
+``net_retry_attempts_total{op,outcome}``:
+
+- ``ok``        — the attempt succeeded
+- ``retry``     — the attempt failed and a backoff sleep follows
+- ``exhausted`` — the attempt failed with no retries left (attempt
+  budget spent, or the next sleep would cross the deadline)
+- ``rejected``  — the error is classified non-retryable (``no_retry``
+  class or the ``giveup`` predicate) — e.g. a peer that ANSWERED with
+  a rejection must not be hammered
+
+``op`` is the call-site tag (partial | sync | repair | control |
+gossip | timelock) — bounded by the code paths that mint it, like the
+ingress-reject verdict label.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random as _random
+from dataclasses import dataclass
+from typing import Awaitable, Callable
+
+from .clock import Clock, SystemClock
+
+
+def _attempt_counter(op: str, outcome: str):
+    """Branch-literal outcome labels (the check_metrics
+    KNOWN_LABEL_VALUES enum rule); ``op`` is dynamic-but-bounded by the
+    call sites, like the ingress-reject verdict."""
+    from .. import metrics
+
+    if outcome == "ok":
+        return metrics.NET_RETRY_ATTEMPTS.labels(op=op, outcome="ok")
+    if outcome == "retry":
+        return metrics.NET_RETRY_ATTEMPTS.labels(op=op, outcome="retry")
+    if outcome == "rejected":
+        return metrics.NET_RETRY_ATTEMPTS.labels(op=op,
+                                                 outcome="rejected")
+    return metrics.NET_RETRY_ATTEMPTS.labels(op=op, outcome="exhausted")
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """How often and how hard to retry. ``attempts`` is the TOTAL try
+    budget (1 = no retries); ``deadline_s`` bounds the whole operation
+    including backoff sleeps — a sleep that would cross it is never
+    started (deadline-aware, not deadline-oblivious)."""
+
+    attempts: int = 3
+    base_s: float = 0.1
+    cap_s: float = 2.0
+    deadline_s: float | None = None
+
+
+DEFAULT_POLICY = RetryPolicy()
+
+
+async def retry(fn: Callable[[], Awaitable], *, op: str,
+                policy: RetryPolicy = DEFAULT_POLICY,
+                clock: Clock | None = None,
+                rng: _random.Random | None = None,
+                retry_on: tuple[type[BaseException], ...] = (Exception,),
+                no_retry: tuple[type[BaseException], ...] = (),
+                giveup: Callable[[BaseException], bool] | None = None):
+    """Run ``await fn()`` under ``policy``.
+
+    - exceptions in ``no_retry`` (checked FIRST — subclasses of a
+      ``retry_on`` class stay non-retryable) or matching ``giveup(e)``
+      re-raise immediately (outcome ``rejected``);
+    - exceptions in ``retry_on`` back off and retry until the attempt
+      budget or deadline runs out (final failure re-raises, outcome
+      ``exhausted``);
+    - anything else — including ``CancelledError`` — propagates
+      untouched and uncounted (it is not a network outcome).
+    """
+    clock = clock if clock is not None else SystemClock()
+    uniform = rng.uniform if rng is not None else _random.uniform
+    start = clock.now()
+    sleep_s = policy.base_s
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            result = await fn()
+        except asyncio.CancelledError:
+            raise
+        except no_retry as e:
+            _attempt_counter(op, "rejected").inc()
+            raise
+        except retry_on as e:
+            if giveup is not None and giveup(e):
+                _attempt_counter(op, "rejected").inc()
+                raise
+            # decorrelated jitter: next sleep in [base, 3*prev], capped
+            sleep_s = min(policy.cap_s, uniform(policy.base_s,
+                                                sleep_s * 3))
+            past_deadline = (
+                policy.deadline_s is not None
+                and clock.now() - start + sleep_s > policy.deadline_s)
+            if attempt >= policy.attempts or past_deadline:
+                _attempt_counter(op, "exhausted").inc()
+                raise
+            _attempt_counter(op, "retry").inc()
+            await clock.sleep(sleep_s)
+        else:
+            _attempt_counter(op, "ok").inc()
+            return result

@@ -74,6 +74,72 @@ def test_loopblock_direct_and_transitive(tmp_path):
     assert "time.sleep" in bad.message and "outer" in bad.message
 
 
+def test_loopblock_retry_sleep_rule(tmp_path):
+    """ISSUE 12: a raw asyncio.sleep inside a retry/backoff loop (a
+    loop that both handles exceptions and backs off) in net/, chain/
+    or timelock/ is a medium finding — retries there must ride the
+    injectable-clock policy. Cooperative sleep(0) yields, clock-policy
+    sleeps, loops without exception handling, and the same shape
+    OUTSIDE the scoped packages all stay clean."""
+    proj = _project(tmp_path, {
+        "drand_tpu/net/dialer.py": """
+            import asyncio
+
+            async def bad_dial(peer):
+                while True:
+                    try:
+                        return await peer.call()
+                    except ConnectionError:
+                        await asyncio.sleep(0.5)
+
+            async def yield_only(stream):
+                for _ in range(4):
+                    try:
+                        pass
+                    except ValueError:
+                        pass
+                    await asyncio.sleep(0)
+
+            async def policy_backoff(peer, clock):
+                while True:
+                    try:
+                        return await peer.call()
+                    except ConnectionError:
+                        await clock.sleep(0.5)
+
+            async def plain_poll(peer):
+                while True:
+                    await asyncio.sleep(0.5)
+        """,
+        "drand_tpu/relay/pump.py": """
+            import asyncio
+
+            async def out_of_scope(peer):
+                while True:
+                    try:
+                        return await peer.call()
+                    except ConnectionError:
+                        await asyncio.sleep(0.5)
+        """,
+    })
+    findings = [f for f in loopblock.run(proj)
+                if f.rule == "retry-sleep"]
+    assert {f.symbol for f in findings} == {"drand_tpu.net.dialer.bad_dial"}
+    f = findings[0]
+    assert f.severity == "medium"
+    assert "injectable-clock" in f.message
+    assert f.key.endswith(":retry-sleep")
+
+
+def test_real_tree_no_retry_sleep_findings():
+    """The live tree is clean under the new rule with ZERO baseline
+    entries — every retry loop in net/, chain/ and timelock/ already
+    goes through drand_tpu.utils.retry."""
+    proj = Project(REPO, packages=("drand_tpu",))
+    assert [f for f in loopblock.run(proj)
+            if f.rule == "retry-sleep"] == []
+
+
 def test_loopblock_pairing_class_is_high(tmp_path):
     """Project-shaped fixture: engine dispatch reachable from an async
     def is high severity — the exact seed bug (sync.py:146)."""

@@ -475,3 +475,159 @@ async def test_no_secret_reaches_logs_metrics_or_flight_under_faults(
             assert str(secret) not in blob
             assert format(secret, "x") not in blob
         assert "pri_share" not in blob
+
+
+# ---------------------------------------------------------------------------
+# 10. asymmetric partition: inbound-only cut, quorum repair pulls across it
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_asymmetric_partition_repair_pulls_across_the_cut():
+    """Every peer's calls TO node 0 are denied while node 0's outbound
+    still works — the asymmetric fault the symmetric partition action
+    cannot model. Node 0's sender-side view stays clean (all sends
+    succeed: zero suspects, full reachability) even though it receives
+    NOTHING — but its peers reached quorum without it and flushed
+    their collectors, so the repair pull comes back answered-empty and
+    the monitor's SYNC leg fetches the stored beacon instead: every
+    round lands on node 0 inside its own period (stored, zero missed)
+    without a local quorum ever forming (margin honestly None). The
+    healthy side never notices (node 0's partials arrive fine)."""
+    with structural_crypto(), isolated_observability():
+        net = ChaosBeaconNetwork(n=5, t=3, period=PERIOD)
+        await net.start_all()
+        await net.advance_to_genesis()
+        sy0 = _sample_count(metrics.GROUP_REGISTRY,
+                            "beacon_partial_repairs", outcome="synced")
+        sched = [FaultEvent(3, "deny", {"src": i, "dst": 0})
+                 for i in range(1, 5)]
+        sched += [FaultEvent(8, "heal")]
+        obs = await net.run_schedule(sched, rounds=8)
+        net.stop_all()
+
+        cut = [ob for ob in obs if 3 <= ob.round < 8]
+        assert cut
+        for ob in cut:
+            # recovered IN-PERIOD via the sync leg: the beacon is on
+            # node 0's chain before its round ends, missed never moves
+            assert ob.stored, f"round {ob.round} not recovered in-period"
+            assert ob.missed_total == 0
+            # no local quorum: the margin SLI stays honestly empty
+            assert ob.margin_s is None
+            # the asymmetric signature: the victim's own sender-side
+            # view is clean — no suspects, nothing unreachable
+            assert ob.suspects == 0
+        assert all(net.flight(0).reachability().values())
+        assert _sample_count(metrics.GROUP_REGISTRY,
+                             "beacon_partial_repairs",
+                             outcome="synced") > sy0
+        # healed: local quorum returns, margins back to the full period
+        assert obs[-1].margin_s == pytest.approx(PERIOD)
+        # the healthy side held full margins throughout (node 0's
+        # outbound partials kept arriving)
+        ob4 = net.observe(cut[0].round, probe=4)
+        assert ob4.margin_s == pytest.approx(PERIOD)
+
+
+# ---------------------------------------------------------------------------
+# 11. slow-loris links: stale rejects never trip the breaker (half-open too)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_slow_loris_rejects_never_trip_breaker_then_half_open_recloses():
+    """Node 4's partials crawl (1.5 periods of link delay), arriving
+    past every receiver's window: each lands an answered STALE reject
+    back on the sender. PeerRejectedError immunity says those must
+    never trip node 4's breakers — its sender-side view stays fully
+    reachable, breaker gauges stay closed. Then a real partition trips
+    the survivors' breakers toward 4 (OPEN on the gauge), and after
+    heal the capped half-open probe re-closes them within a round —
+    the breaker's full state cycle under one schedule."""
+    with structural_crypto(), isolated_observability():
+        net = ChaosBeaconNetwork(n=5, t=3, period=PERIOD)
+        await net.start_all()
+        await net.advance_to_genesis()
+        s0 = _rejects("grpc", "stale")
+        loris = [FaultEvent(3, "link",
+                            {"src": 4, "dst": d,
+                             "policy": LinkPolicy(delay_s=1.5 * PERIOD)})
+                 for d in range(4)]
+        obs = await net.run_schedule(loris, rounds=4)
+
+        for ob in obs[-2:]:
+            # quorum rides the 4 punctual members: full margin, and the
+            # slow peer's column reads missing (its partial never lands
+            # in-window)
+            assert ob.stored and ob.margin_s == pytest.approx(PERIOD)
+            assert ob.bitmap[4] == ".", ob.bitmap
+        # the crawling partials came back as answered stale rejects...
+        assert _rejects("grpc", "stale") > s0
+        # ...and did NOT trip the slow sender's breakers: its view is
+        # all-reachable, every breaker gauge still closed
+        assert all(net.flight(4).reachability().values())
+        for idx in range(5):
+            assert metrics.PEER_BREAKER_STATE.labels(
+                index=str(idx))._value.get() == 0, idx
+        for br in net.handlers[4]._breakers.values():
+            assert br.state == 0
+
+        # now a REAL fault: node 4 unreachable for two rounds
+        part = [FaultEvent(7, "partition",
+                           {"groups": [[0, 1, 2, 3], [4]]})]
+        await net.run_schedule(part, rounds=2)
+        assert metrics.PEER_BREAKER_STATE.labels(
+            index="4")._value.get() == 2  # OPEN on the survivors
+        obs = await net.run_schedule([FaultEvent(9, "heal")], rounds=3)
+        net.stop_all()
+        # half-open probe succeeded after heal: breaker re-closed and
+        # the group is whole again
+        assert metrics.PEER_BREAKER_STATE.labels(
+            index="4")._value.get() == 0
+        assert obs[-1].stored and obs[-1].suspects == 0
+        assert obs[-1].bitmap[4] in "#~", obs[-1].bitmap
+
+
+# ---------------------------------------------------------------------------
+# 12. reshare + partition combo: ceremony stalls cleanly, chain never misses
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_reshare_under_partition_names_dealer_and_never_misses():
+    """The PR-11 reshare-under-churn scenario with the silent dealer
+    also PARTITIONED off the beacon plane: the ceremony still stalls in
+    exactly the deal phase and the complaint map names the partitioned
+    dealer, while the majority's beacon chain rides through with zero
+    missed rounds and the partition is visible as exactly one suspect;
+    after heal the group returns to full participation."""
+    with structural_crypto(), isolated_observability():
+        net = ChaosBeaconNetwork(n=5, t=3, period=PERIOD)
+        await net.start_all()
+        await net.advance_to_genesis()
+        await net.run_schedule([], rounds=2)
+        net.partition([[0, 1, 2, 3], [4]])
+        results = await net.reshare_under_churn({4}, phase_timeout=10.0)
+        obs_part = await net.run_schedule([], rounds=1)
+        net.heal()
+        net.network.allow_all()
+        obs = await net.run_schedule([], rounds=3)
+        net.stop_all()
+
+        sessions = FLIGHT.dkg.sessions()
+        assert len(sessions) == 4
+        for s in sessions:
+            assert s["mode"] == "reshare" and s["done"]
+            assert s["qual"] == [0, 1, 2, 3]
+            assert s["complaints"] == {"4": [0, 1, 2, 3]}
+            deal = s["phases"][0]
+            assert deal["phase"] == "deal"
+            assert deal["end_s"] - deal["start_s"] == pytest.approx(10.0)
+        assert all(r.qual == [0, 1, 2, 3] for r in results)
+        # the chain never missed a round through ceremony + partition,
+        # and the partitioned dealer shows as exactly one suspect
+        ob = obs_part[-1]
+        assert ob.stored and ob.missed_total == 0
+        assert ob.suspects == 1
+        # healed: suspects clear and the group contributes fully again
+        assert obs[-1].missed_total == 0
+        assert obs[-1].suspects == 0
+        assert obs[-1].bitmap[4] in "#~", obs[-1].bitmap

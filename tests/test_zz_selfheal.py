@@ -1,0 +1,490 @@
+"""Self-healing beacon plane (ISSUE 12): the shared retry policy,
+per-peer circuit breakers, quorum repair, and degraded-mode serving —
+each proven through the observability surfaces the chaos oracle
+already trusts (margins, bitmaps, the missed counter, the new
+self-healing metric set).
+
+Late-alphabet filename per the tier-1 chunking convention (ROADMAP
+operational constraint). Host-only: structural crypto where a network
+runs, no device graphs, no fresh XLA compiles.
+"""
+
+import asyncio
+import random
+
+import aiohttp
+import pytest
+from conftest import sample_count as _sample_count
+
+from drand_tpu import metrics
+from drand_tpu.chain.beacon import Beacon
+from drand_tpu.chain.info import Info
+from drand_tpu.client.interface import Client, ClientError, Result
+from drand_tpu.crypto.curves import PointG1
+from drand_tpu.http_server.server import PublicServer
+from drand_tpu.net.packets import PartialRequest
+from drand_tpu.net.transport import (BREAKER_CLOSED, BREAKER_HALF_OPEN,
+                                     BREAKER_OPEN, PeerBreaker,
+                                     PeerRejectedError, TransportError)
+from drand_tpu.obs.state import isolated_observability
+from drand_tpu.testing import chaos as chaos_mod
+from drand_tpu.testing.chaos import (ChaosBeaconNetwork, FaultEvent,
+                                     LinkPolicy, structural_crypto)
+from drand_tpu.utils.clock import FakeClock
+from drand_tpu.utils.retry import RetryPolicy, retry
+
+PERIOD = 4
+
+
+def _retries(op, outcome):
+    return _sample_count(metrics.GROUP_REGISTRY, "net_retry_attempts",
+                         op=op, outcome=outcome)
+
+
+def _repairs(outcome):
+    return _sample_count(metrics.GROUP_REGISTRY, "beacon_partial_repairs",
+                         outcome=outcome)
+
+
+async def _drive(clock: FakeClock, task: asyncio.Future) -> None:
+    """Step a FakeClock through every wake target until the task ends."""
+    while not task.done():
+        await asyncio.sleep(0)
+        nw = clock.next_wake()
+        if nw is not None:
+            await clock.advance(nw - clock.now())
+
+
+# ---------------------------------------------------------------------------
+# 1. the retry policy: backoff window, deadline awareness, outcome metrics
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_retry_policy_backoff_deadline_and_outcomes():
+    with isolated_observability():
+        clock = FakeClock()
+        calls = {"n": 0}
+
+        async def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise TransportError("transient")
+            return "done"
+
+        ok0, rt0 = _retries("partial", "ok"), _retries("partial", "retry")
+        t0 = clock.now()
+        task = asyncio.ensure_future(retry(
+            flaky, op="partial",
+            policy=RetryPolicy(attempts=5, base_s=0.1, cap_s=1.0),
+            clock=clock, rng=random.Random(7),
+            retry_on=(TransportError,)))
+        await _drive(clock, task)
+        assert task.result() == "done" and calls["n"] == 3
+        assert _retries("partial", "ok") == ok0 + 1
+        assert _retries("partial", "retry") == rt0 + 2
+        # two decorrelated-jitter sleeps, each within [base, cap]
+        elapsed = clock.now() - t0
+        assert 0.2 <= elapsed <= 2.0
+
+        # deadline-aware: the next sleep would cross the budget, so the
+        # failure surfaces as exhausted WITHOUT sleeping past it
+        async def always_down():
+            raise TransportError("down")
+
+        ex0 = _retries("sync", "exhausted")
+        t0 = clock.now()
+        task = asyncio.ensure_future(retry(
+            always_down, op="sync",
+            policy=RetryPolicy(attempts=10, base_s=0.5, cap_s=0.5,
+                               deadline_s=1.2),
+            clock=clock, rng=random.Random(7),
+            retry_on=(TransportError,)))
+        await _drive(clock, task)
+        with pytest.raises(TransportError):
+            task.result()
+        assert _retries("sync", "exhausted") == ex0 + 1
+        assert clock.now() - t0 <= 1.2 + 1e-9
+
+        # non-retryable classification: one attempt, outcome rejected
+        async def answered_no():
+            calls["n"] += 1
+            raise PeerRejectedError("stale round")
+
+        calls["n"] = 0
+        rj0 = _retries("partial", "rejected")
+        with pytest.raises(PeerRejectedError):
+            await retry(answered_no, op="partial", clock=clock,
+                        retry_on=(TransportError,),
+                        no_retry=(PeerRejectedError,))
+        assert calls["n"] == 1
+        assert _retries("partial", "rejected") == rj0 + 1
+
+
+# ---------------------------------------------------------------------------
+# 2. breaker unit matrix: trip, immunity, half-open probe cap
+# ---------------------------------------------------------------------------
+
+def test_breaker_trip_threshold_and_reject_immunity():
+    states = []
+    br = PeerBreaker(3, threshold=3, cooldown_s=10.0,
+                     on_state=lambda i, s: states.append((i, s)))
+    assert states == [(3, BREAKER_CLOSED)]
+    # answered-with-reject resets the consecutive-failure count: a
+    # lagging-but-alive peer can NEVER trip the breaker
+    for _ in range(10):
+        br.record(False, 0.0)
+        br.record(False, 0.0)
+        br.record(True, 0.0)  # PeerRejectedError classifies as ok
+    assert br.state == BREAKER_CLOSED
+    # three consecutive transport failures trip it
+    for _ in range(2):
+        br.record(False, 0.0)
+    assert br.state == BREAKER_CLOSED
+    br.record(False, 0.0)
+    assert br.state == BREAKER_OPEN
+    assert states[-1] == (3, BREAKER_OPEN)
+
+
+def test_breaker_half_open_probe_cap_and_reclose():
+    br = PeerBreaker(0, threshold=2, cooldown_s=10.0)
+    br.record(False, 0.0)
+    br.record(False, 0.0)
+    assert br.state == BREAKER_OPEN
+    assert not br.allow(9.9)
+    # one probe per cooldown window, concurrent callers denied
+    assert br.allow(10.0) and br.state == BREAKER_HALF_OPEN
+    assert not br.allow(10.0)
+    assert not br.allow(19.9)
+    # a probe failing LATE (slow link) must not push the reserved slot
+    br.record(False, 15.0)
+    assert br.state == BREAKER_OPEN
+    assert br.allow(20.0), "next probe slot was reserved at grant time"
+    # failures from sends that passed allow() before the trip never
+    # move the slot either
+    br.record(False, 21.0)
+    assert not br.allow(25.0)
+    assert br.allow(30.0)
+    br.record(True, 30.0)
+    assert br.state == BREAKER_CLOSED
+    # wedge regression: a granted probe whose outcome NEVER lands
+    # (caller died between allow and record) must not blacklist the
+    # peer forever — the reserved slot expires after a full cooldown
+    br.record(False, 40.0)
+    br.record(False, 40.0)
+    assert br.allow(50.0) and br.state == BREAKER_HALF_OPEN
+    # outcome never recorded; within the reserved window: denied
+    assert not br.allow(55.0)
+    # past it: grantable again
+    assert br.allow(60.0)
+
+
+# ---------------------------------------------------------------------------
+# 3. quorum repair: the drop-the-push round recovers inside its period
+# ---------------------------------------------------------------------------
+
+# n=5, t=4 drop matrix: nodes 3 and 4 push to nobody, and node 0's
+# pushes to 3 and 4 are lost too — every node's received set stays
+# below t (0,1,2 hold {0,1,2}; 3 holds {1,2,3}; 4 holds {1,2,4}), so
+# the round misses on the passive plane; the union covers all 5
+# indices, so pulls recover it. Drops are receiver-side (in flight):
+# every sender saw a successful send — retries and breakers stay out
+# of the picture, this is PURELY the pull's win.
+def _drop_the_push(at_round: int) -> list[FaultEvent]:
+    evs = []
+    for src in (3, 4):
+        for dst in range(5):
+            if dst != src:
+                evs.append(FaultEvent(at_round, "link",
+                                      {"src": src, "dst": dst,
+                                       "policy": LinkPolicy(drop=1.0)}))
+    for dst in (3, 4):
+        evs.append(FaultEvent(at_round, "link",
+                              {"src": 0, "dst": dst,
+                               "policy": LinkPolicy(drop=1.0)}))
+    return evs
+
+
+@pytest.mark.asyncio
+async def test_quorum_repair_recovers_dropped_push_with_margin():
+    with structural_crypto(), isolated_observability():
+        net = ChaosBeaconNetwork(n=5, t=4, period=PERIOD)
+        await net.start_all()
+        await net.advance_to_genesis()
+        rec0 = _repairs("recovered")
+        obs = await net.run_schedule(_drop_the_push(4), rounds=6)
+        net.stop_all()
+
+        faulted = [ob for ob in obs if ob.round >= 4]
+        assert faulted
+        for ob in faulted:
+            # the round that would have missed recovers INSIDE its own
+            # period: stored, margin still positive, missed never moves
+            assert ob.stored, f"round {ob.round} missed despite repair"
+            assert ob.missed_total == 0
+            assert ob.margin_s is not None and ob.margin_s > 0
+            # the bitmap shows a full quorum of contributors, at least
+            # one of them a dark pusher (3 or 4) whose partial ONLY a
+            # pull could have delivered; the pull stops at threshold,
+            # so one column may legitimately stay dark
+            marks = sum(ob.bitmap.count(c) for c in "#~")
+            assert marks >= 4, ob.bitmap
+            assert ob.bitmap[3] in "#~" or ob.bitmap[4] in "#~", ob.bitmap
+        assert _repairs("recovered") > rec0
+        # the repair milestone landed on the probe's flight record
+        rec = next(r for r in net.flight(0).rounds(16)
+                   if r["round"] == faulted[0].round)
+        names = [m["name"] for m in rec["milestones"]]
+        assert "repair" in names
+
+
+@pytest.mark.asyncio
+async def test_same_drop_schedule_misses_without_repair():
+    """The acceptance control: the identical schedule on the passive
+    (pre-ISSUE-12) plane misses rounds — asserted through the same
+    missed counter + bitmap surfaces."""
+    with structural_crypto(), isolated_observability():
+        net = ChaosBeaconNetwork(n=5, t=4, period=PERIOD, repair=False)
+        await net.start_all()
+        await net.advance_to_genesis()
+        obs = await net.run_schedule(_drop_the_push(4), rounds=6)
+        net.stop_all()
+
+        assert max(ob.missed_total for ob in obs) >= 1
+        missed = [ob for ob in obs if not ob.stored]
+        assert missed, "drop-the-push stored everything without repair?"
+        # the probe's bitmap fingers the dark pushers
+        withmap = [ob for ob in obs if ob.round >= 4 and ob.bitmap]
+        for ob in withmap:
+            assert ob.bitmap[3] == "." and ob.bitmap[4] == ".", ob.bitmap
+
+
+# ---------------------------------------------------------------------------
+# 4. breaker keeps send growth bounded through a no-heal partition
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_breaker_bounds_sends_during_no_heal_partition():
+    with structural_crypto(), isolated_observability():
+        net = ChaosBeaconNetwork(n=4, t=3, period=PERIOD)
+        await net.start_all()
+        await net.advance_to_genesis()
+        await net.run_schedule([], rounds=2)
+        f0 = _sample_count(metrics.GROUP_REGISTRY, "beacon_peer_sends",
+                           index="3", outcome="failed")
+        sched = [FaultEvent(4, "partition",
+                            {"groups": [[0, 1, 2], [3]]})]
+        obs = await net.run_schedule(sched, rounds=6)
+        net.stop_all()
+
+        # majority keeps quorum the whole way
+        for ob in obs:
+            assert ob.stored and ob.missed_total == 0
+        # every surviving node's breaker for peer 3 is OPEN
+        for h in net.handlers[:3]:
+            assert h._breakers[3].state == BREAKER_OPEN
+        # bounded growth: without the breaker each of the 3 senders
+        # would burn its full retry budget every round (3 senders x 6
+        # rounds x 3 attempts = 54 failed sends); with it, each sender
+        # pays the one trip burst plus at most one capped probe per
+        # round
+        failed = _sample_count(metrics.GROUP_REGISTRY,
+                               "beacon_peer_sends",
+                               index="3", outcome="failed") - f0
+        assert failed > 0
+        assert failed <= 3 * (3 + 6), failed
+        assert metrics.PEER_BREAKER_STATE.labels(
+            index="3")._value.get() == BREAKER_OPEN
+
+
+# ---------------------------------------------------------------------------
+# 5. the repair-serving surface: window + per-sender rate cap
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_request_partials_window_and_rate_cap():
+    from drand_tpu.chain.engine import handler as handler_mod
+
+    with structural_crypto(), isolated_observability():
+        net = ChaosBeaconNetwork(n=3, t=2, period=PERIOD)
+        await net.start_all()
+        await net.advance_to_genesis()
+        await net.run_schedule([], rounds=2)
+        h = net.handlers[0]
+        last = net.stores[0].last()
+
+        # stored rounds are not repairable (the sync path's job)
+        with pytest.raises(TransportError):
+            await h.request_partials(
+                "attacker:1", PartialRequest(round=last.round,
+                                             previous_sig=last.signature))
+        # the live window serves the collector's verified set, minus
+        # what the requester already holds
+        live = PartialRequest(round=last.round + 1,
+                              previous_sig=last.signature)
+        served = await h.request_partials("peer:1", live)
+        assert all(p.round == last.round + 1 for p in served)
+        have_all = PartialRequest(round=last.round + 1,
+                                  previous_sig=last.signature,
+                                  have=(0, 1, 2))
+        assert await h.request_partials("peer:1", have_all) == []
+        # per-sender per-round rate cap refuses at the door
+        for _ in range(handler_mod.REPAIR_SERVE_CAP - 2):
+            await h.request_partials("peer:1", live)
+        with pytest.raises(TransportError, match="rate-capped"):
+            await h.request_partials("peer:1", live)
+        # a different sender still gets served
+        assert await h.request_partials("peer:2", live) is not None
+        # an address flood cannot reset a capped sender's budget: after
+        # spraying live-round requests from many spoofed addresses,
+        # the original sender is STILL refused
+        for i in range(4 * 3 + 2):
+            try:
+                await h.request_partials(f"spoof:{i}", live)
+            except TransportError:
+                pass
+        with pytest.raises(TransportError, match="rate-capped"):
+            await h.request_partials("peer:1", live)
+        net.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# 6. syncer failover: resumable checkpoint, no re-verify after a death
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_syncer_resumes_without_reverifying_after_upstream_death():
+    from drand_tpu.chain import beacon as chain_beacon
+    from drand_tpu.chain.store import AppendStore, CallbackStore, MemStore
+    from drand_tpu.chain.engine.sync import Syncer
+    from drand_tpu.crypto import batch
+    from drand_tpu.utils.logging import default_logger
+
+    with structural_crypto(), isolated_observability():
+        # a 12-round structural chain
+        chain = []
+        prev = b"\x00" * 96
+        for r in range(1, 13):
+            sig = chaos_mod.group_sig(chain_beacon.message(r, prev))
+            chain.append(Beacon(round=r, previous_sig=prev, signature=sig))
+            prev = sig
+        info = Info(public_key=PointG1.generator(), period=PERIOD,
+                    genesis_time=100, genesis_seed=b"seed",
+                    group_hash=b"gh")
+        store = CallbackStore(AppendStore(MemStore()))
+        store.put(Beacon(round=0, previous_sig=b"", signature=b"\x00" * 96))
+
+        state = {"dead_once": False}
+
+        class StubClient:
+            async def sync_chain(self, peer, req):
+                for b in chain:
+                    if b.round < req.from_round:
+                        continue
+                    if not state["dead_once"] and b.round > 5:
+                        # mid-chunk upstream death on the first pass
+                        state["dead_once"] = True
+                        raise TransportError("upstream died")
+                    yield b
+
+        verified = []
+        real = batch.verify_beacons
+
+        def counting(pub, beacons, *a, **kw):
+            verified.extend(b.round for b in beacons)
+            return real(pub, beacons, *a, **kw)
+
+        batch.verify_beacons = counting
+        try:
+            rt0 = _retries("sync", "retry")
+            sy = Syncer(default_logger("t", level="none"), store, info,
+                        StubClient(), clock=FakeClock())
+            task = asyncio.ensure_future(sy.follow(12, ["peer"]))
+            await _drive(sy._clock, task)
+            assert task.result() is True
+        finally:
+            batch.verify_beacons = real
+
+        assert store.last().round == 12
+        # the second pass resumed from the checkpoint: every round
+        # verified EXACTLY once, the stored span never re-fetched
+        assert sorted(verified) == list(range(1, 13))
+        assert _retries("sync", "retry") >= rt0 + 1
+
+
+# ---------------------------------------------------------------------------
+# 7. degraded-mode serving: stale /public/latest with the explicit header
+# ---------------------------------------------------------------------------
+
+class _FlakyUpstream(Client):
+    """Serves one beacon, then the upstream 'dies' on demand."""
+
+    def __init__(self, info: Info, result: Result):
+        self._info = info
+        self._result = result
+        self.dead = False
+
+    async def get(self, round_no: int = 0) -> Result:
+        if self.dead:
+            raise ClientError("upstream unreachable")
+        return self._result
+
+    async def info(self) -> Info:
+        if self.dead:
+            raise ClientError("upstream unreachable")
+        return self._info
+
+    async def watch(self):
+        if self.dead:
+            raise ClientError("upstream unreachable")
+        yield self._result
+        await asyncio.Event().wait()
+
+
+async def _get(port, path):
+    async with aiohttp.ClientSession() as s:
+        async with s.get(f"http://127.0.0.1:{port}{path}") as r:
+            return r.status, dict(r.headers), await r.json()
+
+
+@pytest.mark.asyncio
+async def test_relay_serves_stale_with_header_when_upstream_lost():
+    with isolated_observability():
+        info = Info(public_key=PointG1.generator(), period=1,
+                    genesis_time=1, genesis_seed=b"s", group_hash=b"g")
+        res = Result(round=7, signature=b"\x07" * 96,
+                     previous_signature=b"\x06" * 96)
+        upstream = _FlakyUpstream(info, res)
+        server = PublicServer(upstream)
+        site = await server.start("127.0.0.1", 0)
+        port = site._server.sockets[0].getsockname()[1]
+        try:
+            await asyncio.sleep(0.05)  # let the watch loop prime _latest
+            s0 = _sample_count(metrics.HTTP_REGISTRY, "relay_stale_served")
+            status, headers, body = await _get(port, "/public/latest")
+            assert status == 200 and body["round"] == 7
+            assert "X-Drand-Stale" not in headers
+
+            upstream.dead = True
+            status, headers, body = await _get(port, "/public/latest")
+            # degraded mode: last-known beacon, explicit staleness, 200
+            assert status == 200 and body["round"] == 7
+            assert int(headers["X-Drand-Stale"]) > 0
+            assert headers["Cache-Control"] == "no-store"
+            assert _sample_count(metrics.HTTP_REGISTRY,
+                                 "relay_stale_served") == s0 + 1
+        finally:
+            await server.stop()
+
+        # a relay that never saw a beacon still 404s — stale serving
+        # needs something to be stale
+        dead = _FlakyUpstream(info, res)
+        dead.dead = True
+        server = PublicServer(dead)
+        site = await server.start("127.0.0.1", 0)
+        port = site._server.sockets[0].getsockname()[1]
+        try:
+            status, headers, _ = await _get(port, "/public/latest")
+            assert status == 404
+            assert "X-Drand-Stale" not in headers
+        finally:
+            await server.stop()

@@ -17,6 +17,7 @@ sockets, single scalar muls) is medium.
 
 from __future__ import annotations
 
+import ast
 import re
 
 from .core import Finding, Project, SEV_RANK
@@ -76,7 +77,85 @@ DEFAULT_ATTR_LEAVES: dict[str, tuple[str, str]] = {
 # event loop; the analyzer package itself would self-flag its fixtures)
 DEFAULT_EXCLUDE_PREFIXES = ("drand_tpu.testing",)
 
+# retry-sleep rule (ISSUE 12): module path prefixes where a raw
+# ``asyncio.sleep`` inside a retry/backoff loop is a medium finding —
+# retries there must go through drand_tpu/utils/retry.py, whose sleeps
+# ride the INJECTABLE clock, or FakeClock chaos runs lose determinism
+# (a wall-clock sleep is invisible to the fault scheduler's wake-target
+# stepping). A loop counts as retry/backoff when its body both handles
+# an exception (``try/except``) and awaits ``asyncio.sleep`` — the
+# signature of a hand-rolled retry; ``asyncio.sleep(0)`` is a
+# cooperative yield, not a backoff, and stays exempt.
+RETRY_SLEEP_PREFIXES = ("drand_tpu/net/", "drand_tpu/chain/",
+                        "drand_tpu/timelock/")
+
 _MAX_PATH = 7
+
+
+def _retry_sleep_findings(project: Project,
+                          prefixes: tuple[str, ...] = RETRY_SLEEP_PREFIXES,
+                          ) -> list[Finding]:
+    """Medium findings for raw asyncio.sleep in retry loops (see
+    RETRY_SLEEP_PREFIXES). AST-local: nested defs are skipped (they are
+    indexed as their own functions), so a callback defined inside a
+    loop never charges the enclosing function."""
+
+    def _iter_no_nested(node: ast.AST):
+        skip = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                ast.ClassDef)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, skip):
+                continue
+            yield child
+            yield from _iter_no_nested(child)
+
+    def _is_asyncio_sleep(call: ast.Call, imports: dict) -> bool:
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "sleep"
+                and isinstance(f.value, ast.Name)):
+            return False
+        if imports.get(f.value.id, f.value.id) != "asyncio":
+            return False
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and call.args[0].value == 0:
+            return False  # a cooperative yield, not a backoff
+        return True
+
+    findings: list[Finding] = []
+    for fn in project.iter_functions():
+        rel = fn.module.relpath
+        if not rel.startswith(prefixes):
+            continue
+        hit: ast.Call | None = None
+        for loop in _iter_no_nested(fn.node):
+            if not isinstance(loop, (ast.While, ast.For, ast.AsyncFor)):
+                continue
+            body = list(_iter_no_nested(loop))
+            if not any(isinstance(n, ast.Try) for n in body):
+                continue  # no exception handling: not a retry loop
+            for n in body:
+                if isinstance(n, ast.Call) \
+                        and _is_asyncio_sleep(n, fn.module.imports):
+                    hit = n
+                    break
+            if hit is not None:
+                break
+        if hit is None:
+            continue
+        findings.append(Finding(
+            pass_name="loopblock",
+            rule="retry-sleep",
+            severity="medium",
+            path=rel,
+            line=hit.lineno,
+            symbol=fn.qualname,
+            message=(f"`{fn.qualname}` awaits a raw asyncio.sleep inside "
+                     f"a retry/backoff loop — use the injectable-clock "
+                     f"policy (drand_tpu.utils.retry) so FakeClock chaos "
+                     f"runs stay deterministic"),
+            detail="retry-sleep",
+        ))
+    return findings
 
 
 def run(project: Project,
@@ -167,5 +246,6 @@ def run(project: Project,
             # call someone adds to the same function later
             detail=leaf,
         ))
+    findings.extend(_retry_sleep_findings(project))
     findings.sort(key=lambda f: (-SEV_RANK[f.severity], f.path, f.line))
     return findings

@@ -89,6 +89,14 @@ KNOWN_LABEL_VALUES = {
     # here
     "beacon_ingress_rejects_total": {"source": {"grpc", "gossip",
                                                 "self"}},
+    # self-healing set (ISSUE 12). net_retry_attempts_total's `op` is
+    # the call-site tag (partial|sync|repair|control|gossip|timelock) —
+    # bounded by the code paths that mint it, passed through the retry
+    # helper as a variable, so only `outcome` is literal-checkable.
+    "net_retry_attempts_total": {"outcome": {"ok", "retry", "exhausted",
+                                             "rejected"}},
+    "beacon_partial_repairs_total": {"outcome": {"recovered", "synced",
+                                                 "failed"}},
 }
 
 

@@ -42,6 +42,9 @@
 #                          compiles; ~10 s)
 #   test_zz_flight.py      threshold flight recorder suite (host-only)
 #   test_zz_obs_health.py  chain-health SLO / OTLP export suite
+#   test_zz_selfheal.py    self-healing plane: retry policy, breakers,
+#                          quorum repair, stale serving (host-only,
+#                          structural crypto; ~5 s)
 #   test_zz_timelock_serve.py  timelock serving tier
 #
 # Exit status: 0 iff every chunk passed.
