@@ -656,10 +656,10 @@ def cmd_util(args) -> None:
 
 
 def cmd_analyze(args) -> None:
-    """Static-analysis suite (tools/analyze): loopblock, secretflow,
-    jaxhazard, asyncsanity plus the metrics catalogue lint — pure AST,
-    host-only, no backend init. Exit 1 on unsuppressed findings at or
-    above --fail-on."""
+    """Static-analysis suite (tools/analyze): loopblock, lockheld,
+    threadshare, awaitatomic, secretflow, jaxhazard, asyncsanity plus
+    the metrics catalogue lint — pure AST, host-only, no backend init.
+    Exit 1 on unsuppressed findings at or above --fail-on."""
     import pathlib
 
     repo = pathlib.Path(__file__).resolve().parents[2]
@@ -676,6 +676,10 @@ def cmd_analyze(args) -> None:
         argv += ["--passes", args.passes]
     if args.baseline:
         argv += ["--baseline", args.baseline]
+    if args.sarif:
+        argv += ["--sarif", args.sarif]
+    if args.prune_baseline:
+        argv.append("--prune-baseline")
     raise SystemExit(analyze_main(argv))
 
 
@@ -1098,6 +1102,7 @@ def main(argv=None) -> None:
 
     an = sub.add_parser("analyze",
                         help="AST static-analysis suite (loopblock, "
+                             "lockheld, threadshare, awaitatomic, "
                              "secretflow, jaxhazard, asyncsanity, "
                              "metrics lint)")
     an.add_argument("--json", action="store_true",
@@ -1108,6 +1113,12 @@ def main(argv=None) -> None:
                     help="comma-separated pass subset")
     an.add_argument("--baseline", default="",
                     help="override the baseline-suppression file")
+    an.add_argument("--sarif", default="",
+                    help="write unsuppressed findings as SARIF 2.1.0 "
+                         "to this path")
+    an.add_argument("--prune-baseline", action="store_true",
+                    help="rewrite the baseline dropping stale entries "
+                         "(kept reasons preserved)")
     an.set_defaults(fn=cmd_analyze)
 
     r = sub.add_parser("relay")

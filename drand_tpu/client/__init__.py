@@ -76,7 +76,12 @@ class _PinnedClient(Client):
             if got.hash() != self._hash:
                 raise ClientError("source chain info does not match "
                                   "the pinned chain hash")
-            self._info = got
+            # re-check after the await (tools/analyze awaitatomic):
+            # concurrent first callers both fetch, but only the winner
+            # publishes — the info is immutable, so a duplicate fetch
+            # is cheap and a clobbering write is not
+            if self._info is None:
+                self._info = got
         return self._info
 
     async def get(self, round_no: int = 0) -> Result:

@@ -50,9 +50,14 @@ class WatchAggregator(Client):
             try:
                 if self._watch_info is None:
                     try:
-                        self._watch_info = await self._src.info()
+                        got = await self._src.info()
                     except Exception:  # noqa: BLE001 — latency metric only
-                        pass
+                        got = None
+                    # re-check after the await (awaitatomic): the pump
+                    # is single-task today, but the publish must stay
+                    # safe if a second pump ever races the fetch
+                    if got is not None and self._watch_info is None:
+                        self._watch_info = got
                 async for r in self._src.watch():
                     self._observe_latency(r)
                     for q in list(self._subs):

@@ -76,12 +76,15 @@ class GrpcInteropSource(Client):
             packet = pw.decode(pw.CHAIN_INFO_PACKET, raw)
             # ChainInfoPacket carries no genesis_seed (common.proto:48);
             # the seed is only needed to re-derive the genesis beacon
-            self._info = Info(
+            got = Info(
                 public_key=PointG1.from_bytes(packet["public_key"]),
                 period=packet["period"],
                 genesis_time=packet["genesis_time"],
                 genesis_seed=b"",
                 group_hash=packet["group_hash"])
+            # re-check after the await (awaitatomic): first caller wins
+            if self._info is None:
+                self._info = got
         return self._info
 
     def round_at(self, t: float) -> int:

@@ -39,9 +39,12 @@ class GrpcSource(Client):
     async def info(self) -> Info:
         if self._info is None:
             try:
-                self._info = await self._client.chain_info(self._addr)
+                got = await self._client.chain_info(self._addr)
             except TransportError as e:
                 raise ClientError(str(e)) from e
+            # re-check after the await (awaitatomic): first caller wins
+            if self._info is None:
+                self._info = got
         return self._info
 
     def round_at(self, t: float) -> int:

@@ -96,7 +96,7 @@ class HTTPClient(Client):
         if self._info is None:
             d = await self._get_json("/info")
             group_hash = bytes.fromhex(d.get("group_hash", ""))
-            self._info = Info(
+            got = Info(
                 public_key=PointG1.from_bytes(bytes.fromhex(d["public_key"])),
                 period=d["period"],
                 genesis_time=d["genesis_time"],
@@ -104,6 +104,9 @@ class HTTPClient(Client):
                 genesis_seed=group_hash,
                 group_hash=group_hash,
             )
+            # re-check after the await (awaitatomic): first caller wins
+            if self._info is None:
+                self._info = got
         return self._info
 
     def round_at(self, t: float) -> int:

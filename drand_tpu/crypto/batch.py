@@ -48,6 +48,11 @@ _ENGINE = None
 # init must not construct two BatchedEngines (duplicate jit setup,
 # discarded KAT verdicts)
 _ENGINE_LOCK = threading.Lock()
+# warn-once flags + the warm-shape set are written from every dispatch
+# context at once (to_thread workers, the DKG's inline loop path) — one
+# lock covers them all (tools/analyze threadshare: thread-shared mutable
+# state must name its lock)
+_STATE_LOCK = threading.Lock()
 _FALLBACK_LOGGED = False
 
 # Bounded fallback ledger (ISSUE 6 engine introspection): the last N
@@ -101,8 +106,10 @@ def _rlc_threshold() -> int | None:
     try:
         v = int(raw)
     except ValueError:
-        if not _RLC_KNOB_WARNED:
+        with _STATE_LOCK:
+            first = not _RLC_KNOB_WARNED
             _RLC_KNOB_WARNED = True
+        if first:
             from ..utils.logging import default_logger
 
             default_logger("batch").warn(
@@ -125,8 +132,10 @@ def _note_fallback(op: str, err: Exception) -> None:
 
     metrics.ENGINE_FALLBACKS.inc()
     _ledger_note(op, "device", f"{type(err).__name__}: {err}")
-    if not _FALLBACK_LOGGED:
+    with _STATE_LOCK:
+        first = not _FALLBACK_LOGGED
         _FALLBACK_LOGGED = True
+    if first:
         from ..utils.logging import default_logger
 
         default_logger("batch").warn(
@@ -138,7 +147,8 @@ def _note_device_ok() -> None:
     backend that recovers and then breaks AGAIN warns again (the flag
     used to stay set for the life of the process)."""
     global _FALLBACK_LOGGED
-    _FALLBACK_LOGGED = False
+    with _STATE_LOCK:
+        _FALLBACK_LOGGED = False
 
 
 def _note_dispatch(op: str) -> None:
@@ -195,8 +205,14 @@ class _timed:
                      else "_error")
         elif path in _COMPILE_PATHS:
             key = (op, path, bucket)
-            if key not in _WARM_SHAPES:
+            # two workers can land the same cold shape's first dispatch
+            # concurrently (sync catch-up + aggregator): exactly ONE
+            # may claim the compile sample or both disappear from
+            # engine_op_seconds while both feed compile_seconds
+            with _STATE_LOCK:
+                first = key not in _WARM_SHAPES
                 _WARM_SHAPES.add(key)
+            if first:
                 metrics.ENGINE_COMPILE_SECONDS.labels(op=op).observe(dt)
                 return False
         metrics.ENGINE_OP_SECONDS.labels(
@@ -209,15 +225,18 @@ def configure(mode: str, min_batch: int | None = None, engine=None) -> None:
     global _MODE, _MIN_BATCH, _ENGINE
     if mode not in ("auto", "device", "host"):
         raise ValueError(f"unknown engine mode {mode!r}")
-    _MODE = mode
-    if min_batch is not None:
-        _MIN_BATCH = min_batch
+    with _ENGINE_LOCK:
+        _MODE = mode
+        if min_batch is not None:
+            _MIN_BATCH = min_batch
+        if engine is not None:
+            _ENGINE = engine
     if engine is not None:
-        _ENGINE = engine
         # a replacement engine owns no compiled executables: its first
         # dispatch per shape pays the jit compile again and must land in
         # engine_compile_seconds, not the steady-state series
-        _WARM_SHAPES.clear()
+        with _STATE_LOCK:
+            _WARM_SHAPES.clear()
 
 
 def engine():

@@ -19,6 +19,7 @@ from __future__ import annotations
 import hashlib
 import hmac
 import secrets
+import threading
 
 try:
     from cryptography.hazmat.primitives.ciphers.aead import AESGCM
@@ -49,6 +50,10 @@ def _hkdf_sha256(ikm: bytes, length: int) -> bytes:
 
 
 _FALLBACK_WARNED = False
+# private_rand encrypt/decrypt run in to_thread workers while daemon
+# startup paths touch this module on the loop — the warn-once flag is
+# thread-shared (tools/analyze threadshare)
+_WARN_LOCK = threading.Lock()
 
 
 def _warn_fallback() -> None:
@@ -56,9 +61,10 @@ def _warn_fallback() -> None:
     mixed-build group's decrypt failures are diagnosable from THIS node
     (the peer only ever sees 'invalid tag')."""
     global _FALLBACK_WARNED
-    if _FALLBACK_WARNED:
-        return
-    _FALLBACK_WARNED = True
+    with _WARN_LOCK:
+        if _FALLBACK_WARNED:
+            return
+        _FALLBACK_WARNED = True
     from ..utils.logging import default_logger
 
     default_logger("ecies").warn(

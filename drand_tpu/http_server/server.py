@@ -185,7 +185,11 @@ class PublicServer:
             from ..obs import trace as obs_trace
 
             if self._chain_tag is None:
-                self._chain_tag = (await self._get_info()).genesis_seed
+                tag = (await self._get_info()).genesis_seed
+                # re-check after the await (awaitatomic): concurrent
+                # first requests must not clobber the published tag
+                if self._chain_tag is None:
+                    self._chain_tag = tag
             resp.headers[obs_trace.TRACEPARENT_HEADER] = \
                 obs_trace.make_traceparent(
                     obs_trace.round_trace_id(r.round, self._chain_tag))

@@ -158,9 +158,16 @@ class OTLPExporter:
         self._spool_lock = threading.Lock()
         # one long-lived HTTP session per (exporter, event loop): a
         # fresh session per round would re-handshake TCP/TLS to the
-        # collector every period, forever
+        # collector every period, forever. The rebuild is single-flight
+        # per loop (_session_lock): two concurrent exports racing the
+        # check would otherwise both build a session and leak one
+        # unclosed (tools/analyze awaitatomic). asyncio locks are
+        # loop-bound, so the lock is rebuilt alongside the session when
+        # the loop changes — that swap is purely synchronous.
         self._session = None
         self._session_loop = None
+        self._session_lock = None
+        self._session_lock_loop = None
 
     @property
     def active(self) -> bool:
@@ -201,16 +208,22 @@ class OTLPExporter:
         import aiohttp
 
         loop = asyncio.get_running_loop()
-        if (self._session is None or self._session.closed
-                or self._session_loop is not loop):
-            if self._session is not None and not self._session.closed:
-                try:
-                    await self._session.close()
-                except Exception:  # noqa: BLE001 — cross-loop close is
-                    pass           # best-effort; the old loop is gone
-            self._session = aiohttp.ClientSession(
-                timeout=aiohttp.ClientTimeout(total=self.timeout))
-            self._session_loop = loop
+        if self._session_lock is None or self._session_lock_loop is not loop:
+            # no suspension point between this check and the swap, so
+            # the lock replacement itself cannot interleave
+            self._session_lock = asyncio.Lock()
+            self._session_lock_loop = loop
+        async with self._session_lock:
+            if (self._session is None or self._session.closed
+                    or self._session_loop is not loop):
+                if self._session is not None and not self._session.closed:
+                    try:
+                        await self._session.close()
+                    except Exception:  # noqa: BLE001 — cross-loop close
+                        pass           # is best-effort; old loop is gone
+                self._session = aiohttp.ClientSession(
+                    timeout=aiohttp.ClientTimeout(total=self.timeout))
+                self._session_loop = loop
         return self._session
 
     async def _post(self, payload: dict) -> bool:

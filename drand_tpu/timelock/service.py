@@ -141,7 +141,13 @@ class TimelockService:
 
     async def info(self):
         if self._info is None:
-            self._info = await self._client.info()
+            got = await self._client.info()
+            # re-check after the await (tools/analyze awaitatomic): a
+            # boundary-burst of concurrent submits all see None and all
+            # fetch — only the first result is published, so a slow
+            # duplicate fetch can never clobber the cached info mid-use
+            if self._info is None:
+                self._info = got
         return self._info
 
     # ------------------------------------------------------------ submit

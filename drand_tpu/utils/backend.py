@@ -52,7 +52,13 @@ def backend_already_up() -> bool:
 _PROBE_RESULT: Optional[bool] = None
 _PROBE_TIME: float = 0.0
 _PROBE_THREAD: Optional[threading.Thread] = None
+# _PROBE_LOCK serializes the probe itself (held for up to timeout+init
+# — NEVER grab it from the event loop); _VERDICT_LOCK guards the three
+# shared fields above and is only ever held for the assignment, so the
+# loop-side writers (probe_backend_bg, the fast paths) stay non-blocking
+# (tools/analyze threadshare: thread-shared mutable state names its lock)
 _PROBE_LOCK = threading.Lock()
+_VERDICT_LOCK = threading.Lock()
 
 # A negative verdict expires: a daemon outliving a tunnel outage must
 # regain the device path without a restart (ADVICE r4). Positive verdicts
@@ -91,7 +97,8 @@ def probe_backend(timeout: float = 90.0, *, cache: bool = True) -> bool:
     if cache and _PROBE_RESULT is not None and not _probe_expired():
         return _PROBE_RESULT
     if backend_already_up():
-        _PROBE_RESULT = True
+        with _VERDICT_LOCK:
+            _PROBE_RESULT = True
         return True
     # a background probe may already be in flight (daemon startup):
     # join it instead of launching a duplicate subprocess
@@ -108,7 +115,8 @@ def probe_backend(timeout: float = 90.0, *, cache: bool = True) -> bool:
         if env_t is not None:
             timeout = float(env_t)
         if timeout <= 0:
-            _PROBE_RESULT = True
+            with _VERDICT_LOCK:
+                _PROBE_RESULT = True
             return True
         import subprocess
 
@@ -131,8 +139,9 @@ def probe_backend(timeout: float = 90.0, *, cache: bool = True) -> bool:
                 jax.devices()
             except Exception:  # noqa: BLE001 — flapping tunnel
                 ok = False
-        _PROBE_RESULT = ok
-        _PROBE_TIME = time.monotonic()
+        with _VERDICT_LOCK:
+            _PROBE_RESULT = ok
+            _PROBE_TIME = time.monotonic()
         return ok
 
 
@@ -156,13 +165,19 @@ def probe_backend_bg(timeout: float = 90.0) -> None:
     The daemon calls this at startup; crypto/batch.engine calls it on
     first use from loop context."""
     global _PROBE_THREAD
-    if (_PROBE_RESULT is not None and not _probe_expired()) or (
-            _PROBE_THREAD is not None and _PROBE_THREAD.is_alive()):
-        return
-    _PROBE_THREAD = threading.Thread(
-        target=probe_backend, args=(timeout,), daemon=True,
-        name="backend-probe")
-    _PROBE_THREAD.start()
+    # check-and-spawn under the (short) verdict lock: a loop caller and
+    # a worker racing here must not launch two probe subprocesses (the
+    # second would also clobber the first's _PROBE_THREAD handle, so
+    # probe_backend's join-an-in-flight-probe path could join the
+    # wrong thread)
+    with _VERDICT_LOCK:
+        if (_PROBE_RESULT is not None and not _probe_expired()) or (
+                _PROBE_THREAD is not None and _PROBE_THREAD.is_alive()):
+            return
+        _PROBE_THREAD = threading.Thread(
+            target=probe_backend, args=(timeout,), daemon=True,
+            name="backend-probe")
+        _PROBE_THREAD.start()
 
 
 def init_backend(

@@ -554,14 +554,22 @@ def test_baseline_requires_reason_and_flags_stale(tmp_path):
 # ---------------------------------------------------------------------------
 
 
-def test_real_tree_zero_unsuppressed_high():
+def test_real_tree_zero_unsuppressed_high(tmp_path):
     """The PR gate: the repo analyzes clean at --fail-on=high, every
     baseline entry carries a written reason, and the run is host-only
-    fast (no backend init — pure AST)."""
+    fast (no backend init — pure AST). On failure the findings are
+    ALSO written as SARIF next to the test log (ISSUE 13 satellite:
+    auditable CI annotations for exactly what failed the gate)."""
+    from tools.analyze.run import write_sarif
+
     t0 = time.perf_counter()
     report = run_analysis()
     elapsed = time.perf_counter() - t0
     highs = [f for f in report["findings"] if f.severity == "high"]
+    if highs:
+        sarif_path = tmp_path / "analyze-failure.sarif"
+        write_sarif(report, sarif_path)
+        print(f"\nanalyze gate FAILED — SARIF written to {sarif_path}")
     assert highs == [], "\n".join(f.render() for f in highs)
     baseline, problems = load_baseline(
         REPO / "tools" / "analyze" / "baseline.json")
@@ -583,8 +591,11 @@ def test_real_tree_no_pairing_class_async_paths():
     highs = [f for f in loopblock.run(proj)
              if f.severity == "high" and f.key not in baseline]
     assert highs == [], "\n".join(f.render() for f in highs)
-    # the suppression list itself stays tight: reviewed entries only
-    assert len(baseline) <= 1
+    # the suppression list itself stays tight: reviewed entries only —
+    # one loopblock (DKG deal admission) and one lockheld (engine
+    # singleton init, see test_zz_concurrency)
+    assert len([k for k in baseline if k.startswith("loopblock:")]) <= 1
+    assert len(baseline) <= 2
 
 
 def test_metrics_pass_folds_into_runner():

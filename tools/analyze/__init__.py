@@ -2,11 +2,19 @@
 
 Pure-AST — never imports the analyzed code, never initializes a jax
 backend — so the whole suite is host-only and fast enough to gate every
-PR from tier-1. Five passes:
+PR from tier-1. Eight passes:
 
 - ``loopblock``   blocking work (pairings, engine dispatch, sqlite,
                   ``time.sleep``, sync sockets) reachable from an
                   ``async def`` without an executor hand-off
+- ``lockheld``    a ``threading.Lock`` held across an ``await``, an
+                  executor hand-off, or pairing-class work
+- ``threadshare`` unlocked mutation of state shared between the event
+                  loop and ``to_thread`` workers (thread-context map
+                  over the call graph)
+- ``awaitatomic`` check-then-act on shared state split across an
+                  ``await`` (stale-cache TOCTOU); high when the state
+                  is also thread-shared
 - ``secretflow``  secret material flowing into logs, metric labels,
                   exception strings or trace-span attributes
 - ``jaxhazard``   Python control flow on tracers, float dtypes in limb

@@ -1,0 +1,246 @@
+"""awaitatomic: check-then-act on shared state split across an
+``await`` (ISSUE 13).
+
+Single-threaded asyncio code still interleaves — at every ``await``.
+The classic TOCTOU: test an attribute (a cache slot, a "seen" set, a
+lazily-fetched handle), ``await`` something, then act on the result of
+the stale test::
+
+    async def info(self):
+        if self._info is None:            # check
+            self._info = await fetch()    # act — but N tasks raced the
+        return self._info                 # check and ALL fetch
+
+Between the check and the act every other task on the loop runs: two
+concurrent callers both see ``None`` and both fetch (duplicate work,
+double-submit, lost writes when the second overwrite clobbers state the
+first caller already published). The gossip relay's in-flight guard
+(relay/gossip.py ``_inflight``) exists precisely because this bug
+shipped once.
+
+Rule (deliberately narrow — tuned against false positives like every
+pass here): inside one ``async def``, an ``if``/``while`` whose test
+READS ``self.X`` (or a module global), where the guarded branch reaches
+an ``await`` BEFORE it WRITES the same ``self.X``/global (assignment,
+subscript store, or container-mutator call). Reads or writes outside
+the guarded branch don't pair — a ``finally: self._busy = False`` after
+a top-of-function check is a deliberate clear, not a TOCTOU.
+
+Severity: medium — the damage is usually duplicated work or a
+re-inserted cache entry. Escalated to HIGH when the attribute is also
+*thread-shared* (the threadshare pass's dual-context map): then the
+stale check races real OS threads, not just cooperative tasks, and the
+act can corrupt state a worker is mid-way through.
+
+Suppression by construction: a check-then-act wholly inside an ``async
+with <…lock>`` block (an asyncio lock serializing the tasks) is not
+flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Project
+from . import threadshare
+
+DEFAULT_EXCLUDE_PREFIXES = ("drand_tpu.testing",)
+
+
+def _iter_no_nested(node: ast.AST):
+    skip = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+            ast.ClassDef)
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, skip):
+            continue
+        yield child
+        yield from _iter_no_nested(child)
+
+
+def _self_attr_reads(expr: ast.AST) -> set[str]:
+    """Attribute names read off ``self`` anywhere inside ``expr``."""
+    out: set[str] = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.ctx, ast.Load) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            out.add(node.attr)
+    return out
+
+
+def _global_reads(expr: ast.AST, candidates: set[str]) -> set[str]:
+    return {node.id for node in ast.walk(expr)
+            if isinstance(node, ast.Name)
+            and isinstance(node.ctx, ast.Load)
+            and node.id in candidates}
+
+
+def _writes_in(node: ast.AST) -> tuple[set[str], set[str]]:
+    """(self-attr names, bare names) written/mutated by this single
+    statement-level node (no recursion into nested statements)."""
+    attrs: set[str] = set()
+    names: set[str] = set()
+
+    def target(expr: ast.AST) -> None:
+        if isinstance(expr, ast.Name):
+            names.add(expr.id)
+        elif isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name):
+                if expr.value.id == "self":
+                    attrs.add(expr.attr)
+                else:
+                    names.add(expr.value.id)
+        elif isinstance(expr, ast.Subscript):
+            target(expr.value)
+        elif isinstance(expr, (ast.Tuple, ast.List)):
+            for el in expr.elts:
+                target(el)
+
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            target(t)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        target(node.target)
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            target(t)
+    elif isinstance(node, ast.Call) \
+            and isinstance(node.func, ast.Attribute) \
+            and node.func.attr in threadshare.MUTATOR_METHODS:
+        target(node.func.value)
+    return attrs, names
+
+
+class _BranchScan:
+    """Linear scan of a guarded branch: does an await happen between
+    the last CHECK of a watched name and a WRITE to it?
+
+    ``await_count`` advances at every suspension point;
+    ``last_check[name]`` records the count at the most recent
+    ``if``/``while`` test that re-read the name. A write is a finding
+    only when awaits happened since that check — so the documented fix
+    idiom (re-check the attribute after the await, then write with no
+    further suspension) analyzes clean, as does any write the branch
+    makes before its first await."""
+
+    def __init__(self, attrs: set[str], names: set[str]):
+        self.attrs = attrs
+        self.names = names
+        self.await_count = 0
+        # the guarding test itself happened at count 0
+        self.last_check: dict[tuple[str, str], int] = {}
+        self.hits: list[tuple[str, str, int]] = []  # (kind, name, line)
+
+    def scan(self, stmts) -> None:
+        for stmt in stmts:
+            self._scan_node(stmt)
+
+    def _scan_node(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(node, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+            self.await_count += 1  # (__aenter__ suspends too)
+        if isinstance(node, (ast.If, ast.While)):
+            self._scan_node(node.test)
+            for a in _self_attr_reads(node.test) & self.attrs:
+                self.last_check[("attr", a)] = self.await_count
+            for n in _global_reads(node.test, self.names):
+                self.last_check[("global", n)] = self.await_count
+            for stmt in (*node.body, *node.orelse):
+                self._scan_node(stmt)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            # `self._x = await f()`: the value's await resolves BEFORE
+            # the store lands, so scan it first — the single-statement
+            # form is the most common shape of this bug
+            if node.value is not None:
+                self._scan_node(node.value)
+            self._check_writes(node)
+            return
+        self._check_writes(node)
+        for child in ast.iter_child_nodes(node):
+            self._scan_node(child)
+
+    def _check_writes(self, node: ast.AST) -> None:
+        w_attrs, w_names = _writes_in(node)
+        for a in w_attrs & self.attrs:
+            if self.await_count > self.last_check.get(("attr", a), 0):
+                self.hits.append(("attr", a, node.lineno))
+        for n in w_names & self.names:
+            if self.await_count > self.last_check.get(("global", n), 0):
+                self.hits.append(("global", n, node.lineno))
+
+
+def run(project: Project,
+        exclude_prefixes: tuple[str, ...] = DEFAULT_EXCLUDE_PREFIXES,
+        dual_attrs: set | None = None,
+        dual_globals: set | None = None,
+        ) -> list[Finding]:
+    """``dual_attrs``/``dual_globals`` come from
+    ``threadshare.analyze`` (computed here when not supplied) and
+    escalate findings on thread-shared state to high."""
+    if dual_attrs is None or dual_globals is None:
+        _, _, dual_attrs, dual_globals, _ = threadshare.analyze(
+            project, exclude_prefixes)
+
+    mod_globals = threadshare._module_globals(project)
+    findings: list[Finding] = []
+
+    for fn in project.iter_functions():
+        if not fn.is_async:
+            continue
+        if any(fn.qualname.startswith(p) for p in exclude_prefixes):
+            continue
+        candidates = mod_globals.get(fn.module.name, set())
+        seen: set[tuple[str, str]] = set()
+        # async-with-lock regions are serialized: collect their spans
+        locked_lines: set[int] = set()
+        for node in _iter_no_nested(fn.node):
+            if isinstance(node, ast.AsyncWith) and any(
+                    threadshare.lock_name(i.context_expr) is not None
+                    for i in node.items):
+                end = getattr(node, "end_lineno", node.lineno)
+                locked_lines.update(range(node.lineno, end + 1))
+        for node in _iter_no_nested(fn.node):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            if node.lineno in locked_lines:
+                continue
+            attrs = _self_attr_reads(node.test) if fn.cls else set()
+            names = _global_reads(node.test, candidates)
+            if not attrs and not names:
+                continue
+            scan = _BranchScan(attrs, names)
+            scan.scan(node.body)
+            scan_else = _BranchScan(attrs, names)
+            scan_else.scan(node.orelse)
+            for kind, name, line in scan.hits + scan_else.hits:
+                if line in locked_lines or (kind, name) in seen:
+                    continue
+                seen.add((kind, name))
+                shared = ((fn.cls, name) in dual_attrs if kind == "attr"
+                          else (fn.module.name, name) in dual_globals)
+                what = (f"self.{name}" if kind == "attr" else name)
+                findings.append(Finding(
+                    pass_name="awaitatomic",
+                    rule=("check-then-act-threaded" if shared
+                          else "check-then-act"),
+                    severity="high" if shared else "medium",
+                    path=fn.module.relpath, line=line,
+                    symbol=fn.qualname,
+                    message=(f"`{fn.qualname}` checks `{what}` at line "
+                             f"{node.lineno}, awaits, then writes it at "
+                             f"line {line} — every task on the loop "
+                             f"interleaves at the await, so the check "
+                             f"is stale by the time the write lands"
+                             + (" (and the attribute is ALSO touched "
+                                "from worker threads — see "
+                                "threadshare)" if shared else "")
+                             + "; serialize with an asyncio.Lock, an "
+                             "in-flight guard (the gossip _inflight "
+                             "pattern), or re-check after the await"),
+                    detail=name))
+    findings.sort(key=lambda f: (f.path, f.line))
+    return findings

@@ -140,6 +140,7 @@ class Project:
             self._index_module(mod)
         for fn in self.functions.values():
             self._extract_calls(fn)
+        self._link_decorators()
 
     # ------------------------------------------------------------ loading
     def _module_name(self, path: pathlib.Path) -> str:
@@ -235,43 +236,86 @@ class Project:
                     return hit
         return None
 
+    # -------------------------------------------------- symbol resolution
+    def resolve_expr(self, fn: FuncInfo,
+                     expr: ast.AST) -> tuple[str | None, str, str]:
+        """Resolve a Name/Attribute expression in ``fn``'s scope to
+        ``(dotted target or None, bare name, display text)``. Shared by
+        call extraction and the passes that resolve bare function
+        REFERENCES (``asyncio.to_thread(f, ...)`` arguments, thread
+        targets, decorator expressions)."""
+        if isinstance(expr, ast.Name):
+            n = expr.id
+            mod = fn.module
+            for cand in (f"{fn.qualname}.{n}", f"{mod.name}.{n}"):
+                if cand in self.functions:
+                    return cand, n, n
+            if n in mod.imports:
+                return mod.imports[n], n, n
+            return None, n, n
+        if isinstance(expr, ast.Attribute):
+            parts = _dotted(expr)
+            if parts is None:
+                return None, expr.attr, f"?.{expr.attr}"
+            mod = fn.module
+            text = ".".join(parts)
+            if parts[0] == "self" and fn.cls and len(parts) == 2:
+                hit = self._method_lookup(mod, fn.cls, parts[1])
+                return hit, parts[1], text
+            if parts[0] in mod.imports:
+                base = mod.imports[parts[0]]
+                # imported module member (time.sleep, jnp.where, ...) or
+                # an imported project function — the dotted form either way
+                return ".".join([base] + parts[1:]), parts[-1], text
+            if f"{mod.name}.{parts[0]}" in self._classes:
+                # ClassName.method(...) on a module-local class
+                hit = self._method_lookup(
+                    mod, f"{mod.name}.{parts[0]}", parts[-1])
+                return hit, parts[-1], text
+            return None, parts[-1], text
+        return None, "<dynamic>", "<dynamic>"
+
+    def resolve_class(self, fn: FuncInfo, expr: ast.AST) -> str | None:
+        """Resolve an expression naming a project class (a constructor
+        call's ``func``) to its class qualname, else None. Module-local
+        class names resolve here even though ``resolve_expr`` (which
+        answers for *functions*) leaves them None."""
+        if isinstance(expr, ast.Name):
+            mod = fn.module
+            for cand in (f"{mod.name}.{expr.id}",
+                         mod.imports.get(expr.id, "")):
+                if cand and cand in self._classes:
+                    return cand
+            return None
+        target, _, _ = self.resolve_expr(fn, expr)
+        if target is not None and target in self._classes:
+            return target
+        return None
+
+    def class_method(self, cls_qualname: str, name: str) -> str | None:
+        """Method qualname of ``name`` on a project class (base classes
+        included), else None."""
+        if cls_qualname not in self._classes:
+            return None
+        _, _, mod = self._classes[cls_qualname]
+        return self._method_lookup(mod, cls_qualname, name)
+
+    def class_methods(self, cls_qualname: str) -> dict[str, str]:
+        """Own (non-inherited) methods of a project class: name ->
+        qualname; empty for unknown classes."""
+        if cls_qualname not in self._classes:
+            return {}
+        return dict(self._classes[cls_qualname][0])
+
+    def iter_classes(self):
+        """Project class qualnames (the per-class state passes walk)."""
+        return self._classes.keys()
+
     # ------------------------------------------------------ call extraction
     def _extract_calls(self, fn: FuncInfo) -> None:
-        mod = fn.module
-
         def resolve(call: ast.Call) -> CallSite:
-            func = call.func
-            line = call.lineno
-            if isinstance(func, ast.Name):
-                n = func.id
-                for cand in (f"{fn.qualname}.{n}", f"{mod.name}.{n}"):
-                    if cand in self.functions:
-                        return CallSite(cand, n, line, n)
-                if n in mod.imports:
-                    return CallSite(mod.imports[n], n, line, n)
-                return CallSite(None, n, line, n)
-            if isinstance(func, ast.Attribute):
-                parts = _dotted(func)
-                if parts is None:
-                    return CallSite(None, func.attr, line, f"?.{func.attr}")
-                text = ".".join(parts)
-                if parts[0] == "self" and fn.cls and len(parts) == 2:
-                    hit = self._method_lookup(mod, fn.cls, parts[1])
-                    return CallSite(hit, parts[1], line, text)
-                if parts[0] in mod.imports:
-                    base = mod.imports[parts[0]]
-                    fqn = ".".join([base] + parts[1:])
-                    if fqn in self.functions:
-                        return CallSite(fqn, parts[-1], line, text)
-                    # imported module member (time.sleep, jnp.where, ...)
-                    return CallSite(fqn, parts[-1], line, text)
-                if f"{mod.name}.{parts[0]}" in self._classes:
-                    # ClassName.method(...) on a module-local class
-                    hit = self._method_lookup(
-                        mod, f"{mod.name}.{parts[0]}", parts[-1])
-                    return CallSite(hit, parts[-1], line, text)
-                return CallSite(None, parts[-1], line, text)
-            return CallSite(None, "<dynamic>", line, "<dynamic>")
+            target, attr, text = self.resolve_expr(fn, call.func)
+            return CallSite(target, attr, call.lineno, text)
 
         # Lambda is skipped too: a lambda body runs when the lambda is
         # CALLED, not where it is written — attributing its calls to the
@@ -298,6 +342,92 @@ class Project:
             if isinstance(stmt, ast.Call):  # unreachable, Calls are exprs
                 fn.calls.append(resolve(stmt))
             walk(stmt)
+
+    # ------------------------------------------------- decorator wrappers
+    def _passthrough_wrapper(self,
+                             factory: FuncInfo) -> tuple[str, ast.Call] | None:
+        """``(wrapper qualname, the wrapper's param-call node)`` when
+        ``factory`` is a functools.wraps-style pass-through decorator: a
+        sync function taking the wrapped function as a parameter,
+        defining ONE nested def that calls that parameter, and returning
+        the nested def. Anything fancier (argument-taking decorator
+        factories, class decorators) stays unresolved — conservative,
+        like the rest of the graph. The call node anchors the synthetic
+        wrapper->wrapped edge at the real ``f(...)`` site, so passes
+        that match CallSites back to the AST (lockheld) see it."""
+        node = factory.node
+        if factory.is_async or not isinstance(node, ast.FunctionDef):
+            return None
+        params = {a.arg for a in (node.args.posonlyargs + node.args.args)}
+        if not params:
+            return None
+        nested = [n for n in node.body if isinstance(n, ast.FunctionDef)]
+        if len(nested) != 1:
+            return None
+        wrapper = nested[0]
+        returned = any(isinstance(n, ast.Return)
+                       and isinstance(n.value, ast.Name)
+                       and n.value.id == wrapper.name
+                       for n in node.body)
+        if not returned:
+            return None
+        param_call = next(
+            (n for n in ast.walk(wrapper)
+             if isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+             and n.func.id in params), None)
+        if param_call is None:
+            return None
+        wrapper_qn = f"{factory.qualname}.{wrapper.name}"
+        if wrapper_qn not in self.functions:
+            return None
+        return wrapper_qn, param_call
+
+    def _link_decorators(self) -> None:
+        """Resolve calls THROUGH single-decorator pass-through wrappers
+        (ISSUE 13 satellite). ``@deco`` rebinds ``g`` to ``deco``'s
+        returned wrapper, so calling ``g()`` executes BOTH bodies: the
+        wrapper's (which may sleep, lock, or dispatch) and the wrapped
+        function's. The graph previously had only the edge to the
+        wrapped def — a decorator that blocks (or holds a lock) around
+        every call it wraps was a loopblock/lockheld blind spot. Here:
+        a function decorated with exactly ONE bare project decorator
+        whose shape is a pass-through wrapper gains a synthetic edge to
+        the wrapper, and the wrapper gains an edge to each function it
+        wraps — taint then flows through the decoration in both
+        directions, to a fixpoint like every other edge."""
+        # factory qualname -> (wrapper qualname, param-call node) | None
+        wrappers: dict[str, tuple[str, ast.Call] | None] = {}
+
+        def factory_wrapper(qn: str):
+            if qn not in wrappers:
+                info = self.functions.get(qn)
+                wrappers[qn] = (self._passthrough_wrapper(info)
+                                if info is not None else None)
+            return wrappers[qn]
+
+        for fn in list(self.functions.values()):
+            decs = getattr(fn.node, "decorator_list", [])
+            if len(decs) != 1 or not isinstance(decs[0],
+                                                (ast.Name, ast.Attribute)):
+                continue
+            target, _, text = self.resolve_expr(fn, decs[0])
+            if target is None or target not in self.functions:
+                continue
+            hit = factory_wrapper(target)
+            if hit is None:
+                continue
+            wrapper_qn, param_call = hit
+            wrapper = self.functions[wrapper_qn]
+            fn.calls.append(CallSite(
+                wrapper_qn, wrapper_qn.rsplit(".", 1)[-1], fn.line,
+                f"@{text}"))
+            # anchored at the wrapper's real `f(...)` call, under the
+            # param's name, so AST-matching passes see the edge where
+            # the wrapped body actually executes (e.g. inside a
+            # with-lock block)
+            wrapper.calls.append(CallSite(
+                fn.qualname, param_call.func.id, param_call.lineno,
+                f"wraps:{fn.qualname}"))
 
     # ------------------------------------------------------------ helpers
     def iter_functions(self):

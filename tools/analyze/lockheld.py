@@ -1,0 +1,155 @@
+"""lockheld: a ``threading.Lock``/``RLock`` held across an ``await``,
+an executor hand-off, or pairing-class work (ISSUE 13).
+
+The failure mode is process-global, not local: this codebase mixes real
+OS threads (``asyncio.to_thread`` crypto workers, SQLite handles opened
+``check_same_thread=False``) with one event loop, and every shared
+structure is guarded by a *threading* lock. A thread that suspends or
+computes for seconds while holding one of those locks starves every
+other acquirer — and when the next acquirer is LOOP-side code (a
+``/healthz`` probe reading a guarded snapshot, the handler appending a
+flight event), the blocking ``acquire()`` parks the entire event loop
+until the holder finishes. That converts one slow worker into a
+whole-process outage, which is why every rule here is high severity.
+
+Rules (all scoped to the lexical body of a sync ``with <lock>`` block;
+``async with`` is an *asyncio* lock — a different discipline with its
+own pass, awaitatomic):
+
+- ``lock-across-await``: any ``await`` inside the block. The lock stays
+  held across the suspension, for as many loop iterations as the
+  awaited thing takes.
+- ``lock-across-handoff``: an ``asyncio.to_thread`` /
+  ``run_in_executor`` call inside the block — the hand-off *queues*
+  work on another thread; holding a lock the worker (or anyone else)
+  may want is a deadlock-shaped bug even before the await lands.
+- ``lock-over-pairing``: a call inside the block whose blocking taint
+  (loopblock's fixpoint — same leaves, same propagation) is
+  pairing-class high. Tens of milliseconds to seconds of crypto under
+  a lock that loop-side readers contend on.
+
+Lock identification is by name: a ``with`` context expression whose
+final dotted segment ends in ``lock`` (case-insensitive) — ``_lock``,
+``_ENGINE_LOCK``, ``self._ledger_lock`` — matching the repo-wide
+convention the threadshare pass also enforces (new-code rule in
+ROADMAP: thread-shared mutable state must name its lock). Medium-class
+leaves (sqlite, ``time.sleep``) are deliberately NOT flagged under
+locks: single-writer stores hold their one lock across exactly one
+sqlite statement by design (chain/store.py, timelock/vault.py), and
+flagging that idiom would drown the pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, Project, _dotted
+from .loopblock import (DEFAULT_ATTR_LEAVES, DEFAULT_EXCLUDE_PREFIXES,
+                        DEFAULT_LEAVES, blocking_taint, classify_leaf)
+
+LOCK_NAME_RE = re.compile(r"lock$", re.IGNORECASE)
+
+_HANDOFF_ATTRS = ("to_thread", "run_in_executor")
+
+
+def lock_name(expr: ast.AST) -> str | None:
+    """The dotted rendering of a with-item context expression when it
+    names a lock (final segment ends in "lock"), else None. Shared with
+    threadshare, whose guarded-mutation rule must agree on what counts
+    as holding a lock."""
+    # `with self._lock:` / `with _ENGINE_LOCK:` — a bare name/attribute
+    parts = _dotted(expr)
+    if parts is not None and LOCK_NAME_RE.search(parts[-1]):
+        return ".".join(parts)
+    return None
+
+
+def _iter_no_nested(node: ast.AST):
+    skip = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+            ast.ClassDef)
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, skip):
+            continue
+        yield child
+        yield from _iter_no_nested(child)
+
+
+def run(project: Project,
+        leaves: tuple[tuple[str, str, str], ...] = DEFAULT_LEAVES,
+        attr_leaves: dict[str, tuple[str, str]] | None = None,
+        exclude_prefixes: tuple[str, ...] = DEFAULT_EXCLUDE_PREFIXES,
+        ) -> list[Finding]:
+    if attr_leaves is None:
+        attr_leaves = DEFAULT_ATTR_LEAVES
+    leaf_res = [(re.compile(pat), sev, label) for pat, sev, label in leaves]
+    taint = blocking_taint(project, leaves, attr_leaves, exclude_prefixes)
+
+    findings: list[Finding] = []
+
+    def emit(fn, rule: str, line: int, lock: str, what: str,
+             detail: str) -> None:
+        findings.append(Finding(
+            pass_name="lockheld", rule=rule, severity="high",
+            path=fn.module.relpath, line=line, symbol=fn.qualname,
+            message=(f"`{fn.qualname}` holds `{lock}` across {what} — a "
+                     f"loop-side acquirer then blocks the whole event "
+                     f"loop until the holder finishes; narrow the "
+                     f"critical section to the shared-state access"),
+            detail=detail))
+
+    for fn in project.iter_functions():
+        if any(fn.qualname.startswith(p) for p in exclude_prefixes):
+            continue
+        # call-site lookup for taint/leaf classification: the extracted
+        # CallSites carry resolution; match them back to AST calls by
+        # (line, bare name) like asyncsanity does
+        sites: dict[tuple[int, str], list] = {}
+        for cs in fn.calls:
+            sites.setdefault((cs.line, cs.attr), []).append(cs)
+
+        for w in _iter_no_nested(fn.node):
+            if not isinstance(w, ast.With):
+                continue
+            lock = None
+            for item in w.items:
+                lock = lock_name(item.context_expr)
+                if lock is not None:
+                    break
+            if lock is None:
+                continue
+            seen_rules: set[str] = set()
+            for node in (n for stmt in w.body
+                         for n in (stmt, *_iter_no_nested(stmt))):
+                if isinstance(node, ast.Await) \
+                        and "await" not in seen_rules:
+                    seen_rules.add("await")
+                    emit(fn, "lock-across-await", node.lineno, lock,
+                         "an await", f"{lock}:await")
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                name = (func.id if isinstance(func, ast.Name)
+                        else func.attr if isinstance(func, ast.Attribute)
+                        else None)
+                if name is None:
+                    continue
+                if name in _HANDOFF_ATTRS and "handoff" not in seen_rules:
+                    seen_rules.add("handoff")
+                    emit(fn, "lock-across-handoff", node.lineno, lock,
+                         f"an executor hand-off ({name})",
+                         f"{lock}:handoff")
+                    continue
+                for cs in sites.get((node.lineno, name), ()):
+                    hit = classify_leaf(cs, leaf_res, attr_leaves)
+                    if hit is None and cs.target in taint:
+                        sev, leaf, _path = taint[cs.target]
+                        hit = (sev, leaf)
+                    if hit is not None and hit[0] == "high" \
+                            and f"pair:{hit[1]}" not in seen_rules:
+                        seen_rules.add(f"pair:{hit[1]}")
+                        emit(fn, "lock-over-pairing", node.lineno, lock,
+                             f"pairing-class work ({hit[1]})",
+                             f"{lock}:{hit[1]}")
+    findings.sort(key=lambda f: (f.path, f.line))
+    return findings

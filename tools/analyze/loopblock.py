@@ -158,11 +158,34 @@ def _retry_sleep_findings(project: Project,
     return findings
 
 
-def run(project: Project,
-        leaves: tuple[tuple[str, str, str], ...] = DEFAULT_LEAVES,
-        attr_leaves: dict[str, tuple[str, str]] | None = None,
-        exclude_prefixes: tuple[str, ...] = DEFAULT_EXCLUDE_PREFIXES,
-        ) -> list[Finding]:
+def classify_leaf(call, leaf_res, attr_leaves) -> tuple[str, str] | None:
+    """``(severity, leaf description)`` when one call site hits a known
+    blocking leaf (resolved regex or curated bare-attribute list), else
+    None. Shared with the lockheld pass so "pairing-class" can never
+    mean two different things."""
+    if call.target is not None:
+        for rx, sev, label in leaf_res:
+            if rx.search(call.target):
+                return sev, f"{call.target} ({label})"
+        # a project-internal call is not a leaf hit unless the
+        # regex matched; external targets only match via regex
+    if call.target is None and call.attr in attr_leaves:
+        sev, label = attr_leaves[call.attr]
+        return sev, f".{call.attr}(...) ({label})"
+    return None
+
+
+def blocking_taint(project: Project,
+                   leaves: tuple[tuple[str, str, str], ...] = DEFAULT_LEAVES,
+                   attr_leaves: dict[str, tuple[str, str]] | None = None,
+                   exclude_prefixes: tuple[str, ...] =
+                   DEFAULT_EXCLUDE_PREFIXES,
+                   ) -> dict[str, tuple[str, str, tuple[str, ...]]]:
+    """The blocking-taint fixpoint over the call graph:
+    ``qualname -> (severity, leaf description, call path)`` for every
+    function that can reach a known-heavy leaf. This is loopblock's
+    core; the lockheld pass reuses it to decide whether a call made
+    UNDER a lock reaches pairing-class work."""
     if attr_leaves is None:
         attr_leaves = DEFAULT_ATTR_LEAVES
     leaf_res = [(re.compile(pat), sev, label) for pat, sev, label in leaves]
@@ -186,18 +209,7 @@ def run(project: Project,
         if excluded(fn.qualname):
             continue
         for call in fn.calls:
-            sev_label = None
-            if call.target is not None:
-                for rx, sev, label in leaf_res:
-                    if rx.search(call.target):
-                        sev_label = (sev, f"{call.target} ({label})")
-                        break
-                # a project-internal call is not a leaf hit unless the
-                # regex matched; external targets only match via regex
-            if sev_label is None and call.target is None \
-                    and call.attr in attr_leaves:
-                sev, label = attr_leaves[call.attr]
-                sev_label = (sev, f".{call.attr}(...) ({label})")
+            sev_label = classify_leaf(call, leaf_res, attr_leaves)
             if sev_label is not None:
                 offer(fn.qualname, sev_label[0], sev_label[1],
                       (fn.qualname, sev_label[1]))
@@ -222,7 +234,15 @@ def run(project: Project,
         for caller in callers.get(callee, ()):
             if offer(caller, sev, leaf, (caller,) + path):
                 work.append(caller)
+    return taint
 
+
+def run(project: Project,
+        leaves: tuple[tuple[str, str, str], ...] = DEFAULT_LEAVES,
+        attr_leaves: dict[str, tuple[str, str]] | None = None,
+        exclude_prefixes: tuple[str, ...] = DEFAULT_EXCLUDE_PREFIXES,
+        ) -> list[Finding]:
+    taint = blocking_taint(project, leaves, attr_leaves, exclude_prefixes)
     findings: list[Finding] = []
     for fn in project.iter_functions():
         if not fn.is_async or fn.qualname not in taint:

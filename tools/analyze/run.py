@@ -1,15 +1,17 @@
 #!/usr/bin/env python
 """Aggregating runner for the drand-tpu static-analysis suite.
 
-    python tools/analyze/run.py [--json] [--fail-on high|medium|low]
-                                [--passes loopblock,secretflow,...]
+    python tools/analyze/run.py [--json] [--sarif PATH]
+                                [--fail-on high|medium|low]
+                                [--passes loopblock,lockheld,...]
                                 [--baseline PATH] [--root DIR]
+                                [--prune-baseline]
 
     drand-tpu analyze [--json] [--fail-on ...]     (same thing via CLI)
 
 Host-only and import-free with respect to the analyzed code: everything
-is AST, so no jax backend ever initializes and a full-tree run takes
-about a second. Exit status 1 iff any finding at/above ``--fail-on``
+is AST, so no jax backend ever initializes and a full-tree run takes a
+couple of seconds. Exit status 1 iff any finding at/above ``--fail-on``
 (default: high) is not suppressed by the baseline.
 
 Baseline (tools/analyze/baseline.json): reviewed suppressions.
@@ -21,8 +23,36 @@ is itself a high finding. Entries matching nothing (the code got fixed)
 are flagged medium so the file never accretes dead weight. Finding keys
 are printed with each finding and are line-number-free, so baselines
 survive unrelated edits — but loopblock keys DO include the leaf the
-path reaches, so suppressing one reviewed blocking call does not also
-suppress a different blocking call added to the same function later.
+path reaches (and lockheld the lock+hazard, threadshare/awaitatomic
+the state name), so suppressing one reviewed hazard does not also
+suppress a different one added to the same function later.
+``--prune-baseline`` rewrites the baseline file in place, dropping
+entries the current run flags as stale (pass actually ran, key matched
+nothing) while preserving the written reasons of every kept entry.
+
+``--json`` schema (stable; CI parses it)::
+
+    {
+      "findings":   [Finding...],   # unsuppressed, strongest first
+      "suppressed": [Finding...],   # matched a baseline entry
+      "counts":     {"high": N, "medium": N, ...},
+      "fail_on":    "high",
+      "failing":    N               # findings at/above fail_on
+    }
+    Finding = {
+      "pass": str, "rule": str, "severity": "high|medium|low|info",
+      "path": str,                  # repo-relative, forward slashes
+      "line": int,                  # 1-based; advisory (keys are
+      "symbol": str,                #  line-free)
+      "message": str,
+      "key": str                    # the baseline-suppression key
+    }
+
+``--sarif PATH`` additionally writes the unsuppressed findings as SARIF
+2.1.0 (one run, ruleId = "<pass>/<rule>", level error/warning/note for
+high/medium/low, the baseline key under partialFingerprints) so CI can
+annotate diffs; ``tests/test_zz_analyze.py`` emits it on gate failure
+for auditable logs.
 """
 
 from __future__ import annotations
@@ -34,15 +64,19 @@ import sys
 
 if __package__ in (None, ""):  # executed as a script
     sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
-    from tools.analyze import asyncsanity, jaxhazard, loopblock, secretflow
+    from tools.analyze import (asyncsanity, awaitatomic, jaxhazard,
+                               lockheld, loopblock, secretflow,
+                               threadshare)
     from tools.analyze.core import Finding, Project, SEV_RANK
 else:
-    from . import asyncsanity, jaxhazard, loopblock, secretflow
+    from . import (asyncsanity, awaitatomic, jaxhazard, lockheld,
+                   loopblock, secretflow, threadshare)
     from .core import Finding, Project, SEV_RANK
 
 REPO = pathlib.Path(__file__).resolve().parents[2]
 DEFAULT_BASELINE = pathlib.Path(__file__).resolve().parent / "baseline.json"
-PASSES = ("loopblock", "secretflow", "jaxhazard", "asyncsanity", "metrics")
+PASSES = ("loopblock", "lockheld", "threadshare", "awaitatomic",
+          "secretflow", "jaxhazard", "asyncsanity", "metrics")
 
 
 def _metrics_pass(root: pathlib.Path) -> list[Finding]:
@@ -120,6 +154,19 @@ def run_analysis(root: str | pathlib.Path = REPO,
     all_findings: list[Finding] = []
     if "loopblock" in passes:
         all_findings.extend(loopblock.run(project))
+    if "lockheld" in passes:
+        all_findings.extend(lockheld.run(project))
+    if "threadshare" in passes or "awaitatomic" in passes:
+        # one shared context analysis: the thread/loop closure feeds
+        # both passes (awaitatomic escalates on thread-shared attrs)
+        shared = threadshare.analyze(project)
+        if "threadshare" in passes:
+            all_findings.extend(threadshare.run(project, analysis=shared))
+        if "awaitatomic" in passes:
+            _, _, dual_attrs, dual_globals, _ = shared
+            all_findings.extend(awaitatomic.run(
+                project, dual_attrs=dual_attrs,
+                dual_globals=dual_globals))
     if "secretflow" in passes:
         all_findings.extend(secretflow.run(project))
     if "jaxhazard" in passes:
@@ -166,12 +213,100 @@ def run_analysis(root: str | pathlib.Path = REPO,
     }
 
 
+def to_sarif(report: dict, fail_on: str = "high") -> dict:
+    """The report's unsuppressed findings as a SARIF 2.1.0 log (one
+    run; ruleId = "<pass>/<rule>"; the baseline key rides in
+    partialFingerprints so diff-annotation tooling can track a finding
+    across line moves, exactly like the baseline file does)."""
+    level = {"high": "error", "medium": "warning", "low": "note",
+             "info": "note"}
+    rules: dict[str, dict] = {}
+    results = []
+    for f in report["findings"]:
+        rule_id = f"{f.pass_name}/{f.rule}"
+        rules.setdefault(rule_id, {
+            "id": rule_id,
+            "shortDescription": {"text": f"{f.pass_name}: {f.rule}"},
+        })
+        results.append({
+            "ruleId": rule_id,
+            "level": level.get(f.severity, "note"),
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(1, f.line)},
+                },
+                "logicalLocations": [{"fullyQualifiedName": f.symbol}],
+            }],
+            "partialFingerprints": {"drandAnalyzeKey/v1": f.key},
+        })
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "drand-tpu-analyze",
+                "rules": list(rules.values()),
+            }},
+            "results": results,
+            "properties": {"failOn": fail_on,
+                           "counts": report["counts"],
+                           "suppressed": len(report["suppressed"])},
+        }],
+    }
+
+
+def write_sarif(report: dict, path: str | pathlib.Path,
+                fail_on: str = "high") -> None:
+    """Serialize :func:`to_sarif` to ``path`` (the --sarif flag and the
+    tier-1 test's on-failure audit log share this)."""
+    pathlib.Path(path).write_text(
+        json.dumps(to_sarif(report, fail_on), indent=2) + "\n")
+
+
+def prune_baseline(report: dict, passes: tuple[str, ...],
+                   path: pathlib.Path) -> tuple[list[str], int]:
+    """Rewrite the baseline at ``path`` dropping entries the current
+    run proves stale: VALID entries (key + written reason) whose pass
+    actually ran and whose key matched no finding. Malformed entries
+    (missing key/reason) are kept — they are live high findings a human
+    must resolve, not dead weight — and reasons of kept entries are
+    preserved byte-for-byte. Returns (dropped keys, kept count)."""
+    doc = json.loads(path.read_text()) if path.is_file() else {}
+    valid, _problems = load_baseline(path)
+    matched = {f.key for f in report["suppressed"]}
+    kept, dropped = [], []
+    for entry in doc.get("entries", []):
+        key = entry.get("key", "")
+        stale = (key in valid and key not in matched
+                 and key.split(":", 1)[0] in passes)
+        if stale:
+            dropped.append(key)
+        else:
+            kept.append(entry)
+    if dropped:
+        # replace only the entries list: any other top-level keys the
+        # document carries survive the rewrite
+        doc["entries"] = kept
+        path.write_text(json.dumps(doc, indent=2) + "\n")
+    return dropped, len(kept)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="drand analyze",
         description="drand-tpu AST static-analysis suite")
     ap.add_argument("--json", action="store_true",
-                    help="machine-readable output")
+                    help="machine-readable output (schema in the module "
+                         "docstring)")
+    ap.add_argument("--sarif", default=None, metavar="PATH",
+                    help="also write unsuppressed findings as SARIF "
+                         "2.1.0 to PATH (CI diff annotation)")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="rewrite the baseline file dropping entries "
+                         "this run flags as stale (reasons of kept "
+                         "entries preserved)")
     ap.add_argument("--fail-on", choices=("high", "medium", "low"),
                     default="high",
                     help="exit 1 when an unsuppressed finding at/above "
@@ -191,9 +326,32 @@ def main(argv=None) -> int:
     report = run_analysis(root=args.root or REPO, passes=passes,
                           baseline_path=args.baseline)
 
+    if args.prune_baseline:
+        bl = (pathlib.Path(args.baseline) if args.baseline
+              else DEFAULT_BASELINE)
+        dropped, kept = prune_baseline(report, passes, bl)
+        # stderr: --json's stdout is a documented machine contract and
+        # must stay a single parseable JSON document
+        for key in dropped:
+            print(f"prune-baseline: dropped stale entry {key}",
+                  file=sys.stderr)
+        print(f"prune-baseline: {len(dropped)} dropped, {kept} kept "
+              f"({bl})", file=sys.stderr)
+        # the dropped entries' stale-entry findings are resolved by the
+        # rewrite — do not double-report them below
+        report["findings"] = [
+            f for f in report["findings"]
+            if not (f.rule == "stale-entry" and f.symbol in dropped)]
+        report["counts"] = {}
+        for f in report["findings"]:
+            report["counts"][f.severity] = \
+                report["counts"].get(f.severity, 0) + 1
+
     findings = report["findings"]
     threshold = SEV_RANK[args.fail_on]
     failing = [f for f in findings if SEV_RANK[f.severity] >= threshold]
+    if args.sarif:
+        write_sarif(report, args.sarif, args.fail_on)
 
     if args.json:
         print(json.dumps({

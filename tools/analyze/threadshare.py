@@ -1,0 +1,456 @@
+"""threadshare: unlocked mutation of state shared between the event
+loop and worker threads (ISSUE 13).
+
+Since PR 7 every pairing-class call runs in ``asyncio.to_thread``
+workers while the daemon's protocol surfaces stay on the loop — two
+genuinely concurrent worlds sharing one address space. ``go test
+-race`` would catch a write racing a read across that boundary at
+runtime; this pass approximates it statically:
+
+1. **Thread-context map.** Roots are function references handed to a
+   thread: ``asyncio.to_thread(f, ...)``, ``loop.run_in_executor(_, f,
+   ...)``, ``threading.Thread(target=f)``, ``<executor/pool>.submit(f,
+   ...)`` (plus ``functools.partial`` unwrapping and calls inside
+   ``lambda`` hand-offs). The thread context is their forward closure
+   over the call graph — including constructor and context-manager
+   edges (``with _timed(...):`` runs ``__enter__``/``__exit__`` on the
+   dispatching thread).
+2. **Loop-context map.** Roots are every ``async def`` plus callbacks
+   handed to ``call_soon``/``call_soon_threadsafe``/``call_later``;
+   same closure.
+3. A class attribute or module global is **dual-context** when code in
+   BOTH closures touches it (reads count: a loop-side read racing a
+   thread-side write is the bug). Mutating it without holding a lock is
+   a HIGH finding.
+
+"Holding a lock" means the mutation is lexically inside a sync ``with
+<…lock>`` block (the lockheld pass's naming rule — ``async with`` is an
+asyncio lock, which does NOT exclude OS threads), or the mutating
+method is *lock-covered*: every resolved call site of the method sits
+inside such a block of the same project (the ``FlightRecorder._get``
+idiom — private helpers that the public ``note_*`` methods only ever
+invoke under ``self._lock``). That is how ``_lock``-guarded-by-
+construction types — the obs singletons, the stores, the vault — vouch
+themselves without a suppression list.
+
+Known false-negative directions (conservative by design, like the rest
+of the suite): receivers that cannot be resolved (``self._vault.get``
+as a ``to_thread`` argument — an attribute of an attribute), aliasing
+through locals, and dynamic dispatch. ``__init__`` is exempt
+(construction happens-before publication), as are ``__enter__`` /
+``__exit__`` self-attribute writes (context-manager instances are
+per-use by idiom; their *module-global* mutations still count — that
+is exactly how the ``_timed`` warm-shapes race was caught).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .core import Finding, FuncInfo, Project, _dotted
+from .lockheld import lock_name
+
+DEFAULT_EXCLUDE_PREFIXES = ("drand_tpu.testing",)
+
+# container-mutating method names: obj.X.<these>(...) mutates obj.X
+MUTATOR_METHODS = frozenset((
+    "append", "appendleft", "add", "discard", "remove", "clear",
+    "update", "pop", "popleft", "popitem", "setdefault", "extend",
+    "insert", "move_to_end",
+))
+
+_LOOP_CB_ATTRS = {"call_soon": 0, "call_soon_threadsafe": 0,
+                  "call_later": 1, "call_at": 1}
+
+THREAD = "thread"
+LOOP = "loop"
+
+
+def _iter_no_nested(node: ast.AST):
+    skip = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+            ast.ClassDef)
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, skip):
+            continue
+        yield child
+        yield from _iter_no_nested(child)
+
+
+@dataclass
+class _Touch:
+    """One self-attribute or module-global access site."""
+
+    fn: FuncInfo
+    name: str           # attribute name / global name
+    line: int
+    mutates: bool
+    locked: bool        # lexically inside a sync `with <lock>` block
+
+
+@dataclass
+class _FnFacts:
+    """Everything this pass needs from one function's AST, collected in
+    a single locked-region-aware walk."""
+
+    attr_touches: list[_Touch] = field(default_factory=list)
+    global_touches: list[_Touch] = field(default_factory=list)
+    extra_callees: list[str] = field(default_factory=list)
+    thread_refs: list[str] = field(default_factory=list)
+    loop_refs: list[str] = field(default_factory=list)
+    locked_callees: list[str] = field(default_factory=list)
+    unlocked_callees: list[str] = field(default_factory=list)
+
+
+def _module_globals(project: Project) -> dict[str, set[str]]:
+    """module name -> names bound at module top level (assignment
+    targets only — the mutable-state candidates; imports resolve via
+    the imports map instead)."""
+    out: dict[str, set[str]] = {}
+    for mod in project.modules.values():
+        names: set[str] = set()
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                if isinstance(node.target, ast.Name):
+                    names.add(node.target.id)
+        out[mod.name] = names
+    return out
+
+
+def _resolve_ref(project: Project, fn: FuncInfo,
+                 expr: ast.AST) -> list[str]:
+    """Project-function qualnames a bare callable reference can reach:
+    a Name/Attribute, a ``functools.partial(f, ...)`` call, or the
+    calls inside a ``lambda`` body."""
+    if isinstance(expr, ast.Lambda):
+        out = []
+        for node in ast.walk(expr.body):
+            if isinstance(node, ast.Call):
+                target, _, _ = project.resolve_expr(fn, node.func)
+                if target in project.functions:
+                    out.append(target)
+        return out
+    if isinstance(expr, ast.Call):
+        # functools.partial(f, ...) hands off f
+        _, attr, _ = project.resolve_expr(fn, expr.func)
+        if attr == "partial" and expr.args:
+            return _resolve_ref(project, fn, expr.args[0])
+        return []
+    target, _, _ = project.resolve_expr(fn, expr)
+    return [target] if target in project.functions else []
+
+
+def _collect(project: Project, fn: FuncInfo,
+             mod_globals: dict[str, set[str]]) -> _FnFacts:
+    facts = _FnFacts()
+    globals_here = mod_globals.get(fn.module.name, set())
+    # names that are local to this function shadow module globals —
+    # unless declared `global`
+    declared_global: set[str] = set()
+    local_names: set[str] = set()
+    args = fn.node.args
+    for a in (args.posonlyargs + args.args + args.kwonlyargs
+              + ([args.vararg] if args.vararg else [])
+              + ([args.kwarg] if args.kwarg else [])):
+        local_names.add(a.arg)
+    for node in _iter_no_nested(fn.node):
+        if isinstance(node, ast.Global):
+            declared_global.update(node.names)
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            local_names.add(node.id)
+
+    def is_global(name: str) -> bool:
+        return (name in globals_here
+                and (name in declared_global or name not in local_names))
+
+    def touch_attr(name: str, line: int, mutates: bool,
+                   locked: bool) -> None:
+        facts.attr_touches.append(_Touch(fn, name, line, mutates, locked))
+
+    def touch_global(name: str, line: int, mutates: bool,
+                     locked: bool) -> None:
+        if is_global(name):
+            facts.global_touches.append(
+                _Touch(fn, name, line, mutates, locked))
+
+    def self_attr(expr: ast.AST) -> str | None:
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self":
+            return expr.attr
+        return None
+
+    def mutation_target(expr: ast.AST, line: int, locked: bool) -> None:
+        """Record an assignment/deletion target as a mutation."""
+        if isinstance(expr, ast.Name):
+            touch_global(expr.id, line, True, locked)
+            return
+        a = self_attr(expr)
+        if a is not None:
+            touch_attr(a, line, True, locked)
+            return
+        if isinstance(expr, ast.Subscript):
+            # self.X[k] = v / G[k] = v mutate the container X / G
+            mutation_target(expr.value, line, locked)
+        elif isinstance(expr, (ast.Tuple, ast.List)):
+            for el in expr.elts:
+                mutation_target(el, line, locked)
+
+    def walk(node: ast.AST, locked: bool) -> None:
+        skip = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                ast.ClassDef)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, skip):
+                continue
+            if isinstance(child, ast.With):
+                inner = locked or any(
+                    lock_name(item.context_expr) is not None
+                    for item in child.items)
+                # CM classes: `with C(...):` runs __enter__/__exit__
+                for item in child.items:
+                    if isinstance(item.context_expr, ast.Call):
+                        cls = project.resolve_class(
+                            fn, item.context_expr.func)
+                        if cls is not None:
+                            for m in ("__init__", "__enter__", "__exit__"):
+                                qn = project.class_method(cls, m)
+                                if qn is not None:
+                                    facts.extra_callees.append(qn)
+                for sub in child.items:
+                    walk(sub.context_expr, locked)
+                for stmt in child.body:
+                    walk(stmt, inner)
+                    _visit(stmt, inner)
+                continue
+            _visit(child, locked)
+            walk(child, locked)
+
+    def _visit(child: ast.AST, locked: bool) -> None:
+        if isinstance(child, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (child.targets if isinstance(child, ast.Assign)
+                       else [child.target])
+            for t in targets:
+                mutation_target(t, child.lineno, locked)
+            if isinstance(child, ast.AugAssign):
+                pass  # target covered above; value side visited below
+        elif isinstance(child, ast.Delete):
+            for t in child.targets:
+                mutation_target(t, child.lineno, locked)
+        elif isinstance(child, ast.Call):
+            func = child.func
+            # obj.X.append(...) — a mutator call on the container
+            if isinstance(func, ast.Attribute) \
+                    and func.attr in MUTATOR_METHODS:
+                a = self_attr(func.value)
+                if a is not None:
+                    touch_attr(a, child.lineno, True, locked)
+                elif isinstance(func.value, ast.Name):
+                    touch_global(func.value.id, child.lineno, True,
+                                 locked)
+            # thread hand-offs / loop callbacks / callee bookkeeping
+            _classify_call(child, locked)
+        elif isinstance(child, ast.Attribute) \
+                and isinstance(child.ctx, ast.Load):
+            a = self_attr(child)
+            if a is not None:
+                touch_attr(a, child.lineno, False, locked)
+        elif isinstance(child, ast.Name) and isinstance(child.ctx,
+                                                        ast.Load):
+            touch_global(child.id, child.lineno, False, locked)
+
+    def _classify_call(call: ast.Call, locked: bool) -> None:
+        func = call.func
+        target, attr, _ = project.resolve_expr(fn, func)
+        if attr == "to_thread" and call.args:
+            facts.thread_refs.extend(_resolve_ref(project, fn,
+                                                  call.args[0]))
+        elif attr == "run_in_executor" and len(call.args) >= 2:
+            facts.thread_refs.extend(_resolve_ref(project, fn,
+                                                  call.args[1]))
+        elif attr == "Thread" or target == "threading.Thread":
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    facts.thread_refs.extend(
+                        _resolve_ref(project, fn, kw.value))
+        elif attr == "submit" and call.args \
+                and isinstance(func, ast.Attribute):
+            recv = _dotted(func.value)
+            if recv and any(s in recv[-1].lower()
+                            for s in ("executor", "pool")):
+                facts.thread_refs.extend(_resolve_ref(project, fn,
+                                                      call.args[0]))
+        elif attr in _LOOP_CB_ATTRS:
+            idx = _LOOP_CB_ATTRS[attr]
+            if len(call.args) > idx:
+                facts.loop_refs.extend(_resolve_ref(project, fn,
+                                                    call.args[idx]))
+        if target in project.functions:
+            (facts.locked_callees if locked
+             else facts.unlocked_callees).append(target)
+        else:
+            cls = project.resolve_class(fn, func)
+            if cls is not None:
+                qn = project.class_method(cls, "__init__")
+                if qn is not None:
+                    facts.extra_callees.append(qn)
+
+    walk(fn.node, False)
+    return facts
+
+
+def analyze(project: Project,
+            exclude_prefixes: tuple[str, ...] = DEFAULT_EXCLUDE_PREFIXES):
+    """The shared context analysis: returns ``(contexts, facts_by_fn,
+    dual_attrs, dual_globals, lock_covered)`` where ``contexts`` maps
+    function qualnames to subsets of {"thread", "loop"}, ``dual_attrs``
+    is ``{(class_qualname, attr)}`` and ``dual_globals`` is
+    ``{(module, name)}`` touched from both worlds, and ``lock_covered``
+    is the set of methods whose every resolved call site sits inside a
+    with-lock block. awaitatomic reuses this to escalate TOCTOU
+    findings on thread-shared attributes."""
+
+    def excluded(qn: str) -> bool:
+        return any(qn.startswith(p) for p in exclude_prefixes)
+
+    mod_globals = _module_globals(project)
+    facts: dict[str, _FnFacts] = {}
+    for fn in project.iter_functions():
+        if excluded(fn.qualname):
+            continue
+        facts[fn.qualname] = _collect(project, fn, mod_globals)
+
+    # forward edges: resolved calls + constructor/CM edges
+    edges: dict[str, set[str]] = {}
+    for qn, f in facts.items():
+        outs: set[str] = set()
+        for cs in project.functions[qn].calls:
+            if cs.target in project.functions \
+                    and not excluded(cs.target):
+                outs.add(cs.target)
+        outs.update(t for t in f.extra_callees if not excluded(t))
+        edges[qn] = outs
+
+    contexts: dict[str, set[str]] = {qn: set() for qn in facts}
+
+    def flood(roots: set[str], tag: str) -> None:
+        work = [r for r in roots if r in contexts]
+        for r in work:
+            contexts[r].add(tag)
+        while work:
+            qn = work.pop()
+            for callee in edges.get(qn, ()):
+                if tag not in contexts[callee]:
+                    contexts[callee].add(tag)
+                    work.append(callee)
+
+    thread_roots: set[str] = set()
+    loop_roots: set[str] = set()
+    for qn, f in facts.items():
+        thread_roots.update(f.thread_refs)
+        loop_roots.update(f.loop_refs)
+        if project.functions[qn].is_async:
+            loop_roots.add(qn)
+    flood(thread_roots, THREAD)
+    flood(loop_roots, LOOP)
+
+    # lock-covered methods: every resolved call site sits inside a
+    # with-lock block (the FlightRecorder._get idiom)
+    called_locked: set[str] = set()
+    called_unlocked: set[str] = set()
+    for f in facts.values():
+        called_locked.update(f.locked_callees)
+        called_unlocked.update(f.unlocked_callees)
+    lock_covered = called_locked - called_unlocked
+
+    # context per (class, attr) / (module, global): reads AND writes
+    # outside __init__ count — a loop-side read racing a thread-side
+    # write is the bug this pass exists for
+    attr_ctx: dict[tuple[str, str], set[str]] = {}
+    global_ctx: dict[tuple[str, str], set[str]] = {}
+    global_mutated: set[tuple[str, str]] = set()
+    for qn, f in facts.items():
+        fn = project.functions[qn]
+        ctx = contexts[qn]
+        if fn.cls is not None and fn.node.name != "__init__":
+            for t in f.attr_touches:
+                attr_ctx.setdefault((fn.cls, t.name), set()).update(ctx)
+        for t in f.global_touches:
+            key = (fn.module.name, t.name)
+            global_ctx.setdefault(key, set()).update(ctx)
+            if t.mutates:
+                global_mutated.add(key)
+
+    dual_attrs = {k for k, c in attr_ctx.items() if THREAD in c
+                  and LOOP in c}
+    dual_globals = {k for k, c in global_ctx.items()
+                    if THREAD in c and LOOP in c and k in global_mutated}
+    return contexts, facts, dual_attrs, dual_globals, lock_covered
+
+
+def run(project: Project,
+        exclude_prefixes: tuple[str, ...] = DEFAULT_EXCLUDE_PREFIXES,
+        analysis=None) -> list[Finding]:
+    """``analysis`` is an optional precomputed :func:`analyze` result —
+    the runner shares one with awaitatomic instead of walking twice."""
+    contexts, facts, dual_attrs, dual_globals, lock_covered = \
+        analysis if analysis is not None \
+        else analyze(project, exclude_prefixes)
+
+    findings: list[Finding] = []
+    seen: set[tuple[str, str]] = set()  # (fn qualname, state name)
+    for qn, f in facts.items():
+        fn = project.functions[qn]
+        vouched = qn in lock_covered
+        if fn.node.name in ("__init__",):
+            continue
+        ctx = contexts[qn]
+        if not ctx:
+            continue  # unreachable from either world: no race partner
+        attr_exempt = fn.node.name in ("__enter__", "__exit__")
+        for t in f.attr_touches:
+            if not t.mutates or t.locked or vouched or attr_exempt:
+                continue
+            if fn.cls is None or (fn.cls, t.name) not in dual_attrs:
+                continue
+            if (qn, t.name) in seen:
+                continue
+            seen.add((qn, t.name))
+            findings.append(Finding(
+                pass_name="threadshare", rule="unlocked-shared-mutation",
+                severity="high", path=fn.module.relpath, line=t.line,
+                symbol=qn,
+                message=(f"`{qn}` mutates `self.{t.name}` without the "
+                         f"owning lock, but `{fn.cls.rsplit('.', 1)[-1]}"
+                         f".{t.name}` is reachable from BOTH the event "
+                         f"loop and to_thread workers "
+                         f"({'+'.join(sorted(ctx))} context here) — "
+                         f"guard the mutation with the class lock or "
+                         f"confine the state to one context"),
+                detail=t.name))
+        for t in f.global_touches:
+            if not t.mutates or t.locked or vouched:
+                continue
+            key = (fn.module.name, t.name)
+            if key not in dual_globals:
+                continue
+            if (qn, t.name) in seen:
+                continue
+            seen.add((qn, t.name))
+            findings.append(Finding(
+                pass_name="threadshare", rule="unlocked-global-mutation",
+                severity="high", path=fn.module.relpath, line=t.line,
+                symbol=qn,
+                message=(f"`{qn}` mutates module global `{t.name}` "
+                         f"without a lock, but `{fn.module.name}."
+                         f"{t.name}` is touched from BOTH the event "
+                         f"loop and to_thread workers "
+                         f"({'+'.join(sorted(ctx))} context here) — "
+                         f"guard it with a module lock (the _H2C_LOCK "
+                         f"pattern) or confine it to one context"),
+                detail=t.name))
+    findings.sort(key=lambda f: (f.path, f.line))
+    return findings

@@ -40,6 +40,15 @@
 #   test_zz_chaos.py       chaos network simulator (host-only,
 #                          structural crypto — no pairings, no
 #                          compiles; ~10 s)
+#   test_zz_concurrency.py concurrency-analysis tier: lockheld/
+#                          threadshare/awaitatomic fixtures, thread
+#                          hammers, cache-race regressions (host-only,
+#                          pure AST + threads, no compiles; ~7 s).
+#                          CONFLICTS check vs test_zz_analyze: both
+#                          parse the full tree (~2 s each, CPU-bound,
+#                          no shared mutable state, no clocks) — they
+#                          coexist in one chunk fine; no pair entry
+#                          needed.
 #   test_zz_flight.py      threshold flight recorder suite (host-only)
 #   test_zz_obs_health.py  chain-health SLO / OTLP export suite
 #   test_zz_selfheal.py    self-healing plane: retry policy, breakers,
