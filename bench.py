@@ -28,15 +28,23 @@ Environment knobs:
     BENCH_MIN_SECONDS  minimum timed window per trial (default 5.0)
     BENCH_TRIALS       trials per config (default 2; best wins)
     BENCH_CONFIGS      comma list to run: any of
-                       msm,glv4,rlc,obs,flight,chaos,timelock,shard,
-                       e2e,catchup,recover,deal,replay,headline
+                       msm,glv4,rlc,obs,flight,chaos,timelock,fanout,
+                       segstore,shard,e2e,catchup,recover,deal,replay,
+                       headline
                        (default: all; msm, glv4, rlc, obs, flight,
-                       chaos and timelock are host-only and run FIRST,
-                       before backend init, so they report even with
-                       the TPU tunnel down — shard re-execs onto the
-                       virtual CPU mesh and is bounded by the remaining
-                       budget)
+                       chaos, timelock, fanout and segstore are
+                       host-only and run FIRST, before backend init, so
+                       they report even with the TPU tunnel down —
+                       shard re-execs onto the virtual CPU mesh and is
+                       bounded by the remaining budget)
     BENCH_CHAOS_N      chaos_soak network size (default 32)
+    BENCH_FANOUT_WATCHERS  relay_fanout concurrent watchers (10000)
+    BENCH_FANOUT_SOCKETS   how many of them are real TCP SSE streams
+                           (1024; 2 fds per socket watcher under the
+                           box's 20k rlimit caps this)
+    BENCH_FANOUT_ROUNDS    rounds to hold the watchers through (10)
+    BENCH_SEGSTORE_DEPTH   segment-vs-sqlite chain depth (1000000)
+    BENCH_SEGSTORE_READ    rounds per cursor_from walk (200000)
     DRAND_TPU_CONV     tree|kara|unroll — limb conv strategy (A/B)
     DRAND_TPU_LAZY     1|0 — lazy Fp2/6/12 reduction (A/B)
     DRAND_TPU_PAIRFOLD 1|0 — paired-line Miller fold (A/B)
@@ -810,6 +818,295 @@ def bench_timelock_throughput(trials):
             "vs_baseline": None}
 
 
+def bench_relay_fanout(trials):
+    """Edge fan-out proof (ISSUE 14): a real PublicServer on the wall
+    clock holds 10k+ concurrent /public/latest watchers through 10
+    one-second rounds and reports (a) hub publishes per round — the
+    per-worker wakeup count, which must be ~1 and NOT O(watchers) —
+    (b) p99 boundary-to-delivery latency measured at the consumers,
+    and (c) load-shed correctness on a capped sibling server (429 +
+    Retry-After inside the round period, every shed counted). A slice
+    of the watchers (BENCH_FANOUT_SOCKETS) are real TCP SSE streams;
+    the rest subscribe at the hub layer (one process cannot hold 2 fds
+    x 10k watchers under the 20k rlimit — the hub queue is the same
+    code path either way, the sockets prove the framing/backpressure
+    half at scale). Host-only, runs FIRST before backend init."""
+    import asyncio
+
+    import aiohttp
+
+    from drand_tpu import metrics
+    from drand_tpu.chain import time_math
+    from drand_tpu.chain.info import Info
+    from drand_tpu.client.interface import Client, ClientError, Result
+    from drand_tpu.crypto.curves import PointG1
+    from drand_tpu.http_server import fanout
+    from drand_tpu.http_server.server import PublicServer
+
+    watchers = int(os.environ.get("BENCH_FANOUT_WATCHERS", "10000"))
+    sockets = min(int(os.environ.get("BENCH_FANOUT_SOCKETS", "1024")),
+                  watchers)
+    rounds = int(os.environ.get("BENCH_FANOUT_ROUNDS", "10"))
+    period = 1
+    genesis = int(time.time()) + 3
+    boundary_perf: dict[int, float] = {}
+
+    class Upstream(Client):
+        def __init__(self):
+            self.latest = None
+
+        async def info(self):
+            return Info(public_key=PointG1.generator(), period=period,
+                        genesis_time=genesis, genesis_seed=b"f" * 32,
+                        group_hash=b"f" * 32)
+
+        async def get(self, round_no=0):
+            if round_no == 0 and self.latest is not None:
+                return self.latest
+            raise ClientError("no beacon yet")
+
+        async def watch(self):
+            while True:
+                now = time.time()
+                next_r, next_t = time_math.next_round(int(now), period,
+                                                      genesis)
+                await asyncio.sleep(max(0.0, next_t - now))
+                r = next_r - 1
+                # anchor the round's SCHEDULED boundary on the perf
+                # clock (subtract the sleep overshoot) so consumer-side
+                # deltas measure boundary-to-delivery, not wake jitter
+                boundary_perf[r] = (time.perf_counter()
+                                    - (time.time() - next_t))
+                self.latest = Result(round=r,
+                                     signature=bytes([r % 251]) * 96)
+                yield self.latest
+
+    deliveries: list[float] = []  # boundary->consumer, all watchers
+
+    async def run():
+        upstream = Upstream()
+        server = PublicServer(upstream, max_watchers=watchers + 64)
+        site = await server.start("127.0.0.1", 0)
+        port = site._server.sockets[0].getsockname()[1]
+        url = f"http://127.0.0.1:{port}/public/latest"
+        stop = asyncio.Event()
+        counts: list[int] = []
+
+        async def hub_watcher():
+            sub = server._hub.subscribe(fanout.PROTO_NDJSON)
+            seen = 0
+            try:
+                while not stop.is_set():
+                    item = await sub.next()
+                    if item is None:
+                        break
+                    t = time.perf_counter()
+                    r = item[0]
+                    if r in boundary_perf:
+                        deliveries.append(t - boundary_perf[r])
+                    seen += 1
+                    if seen >= rounds:
+                        break
+            finally:
+                server._hub.unsubscribe(sub)
+                counts.append(seen)
+
+        async def sock_watcher(sess):
+            seen = 0
+            try:
+                async with sess.get(
+                        url, headers={"Accept": "text/event-stream"}
+                ) as resp:
+                    if resp.status != 200:
+                        counts.append(-1)
+                        return
+                    rid = None
+                    while seen < rounds and not stop.is_set():
+                        line = await resp.content.readline()
+                        if not line:
+                            break
+                        if line.startswith(b"id: "):
+                            rid = int(line[4:])
+                        elif line == b"\n" and rid is not None:
+                            t = time.perf_counter()
+                            if rid in boundary_perf:
+                                deliveries.append(
+                                    t - boundary_perf[rid])
+                            seen += 1
+                            rid = None
+            except (aiohttp.ClientError, ConnectionError):
+                pass
+            finally:
+                counts.append(seen)
+
+        conn = aiohttp.TCPConnector(limit=0)
+        sess = aiohttp.ClientSession(
+            connector=conn, timeout=aiohttp.ClientTimeout(total=None))
+        tasks = [asyncio.ensure_future(hub_watcher())
+                 for _ in range(watchers - sockets)]
+        # sockets come up in waves so the connect burst doesn't blow
+        # the accept backlog
+        for lo in range(0, sockets, 128):
+            tasks += [asyncio.ensure_future(sock_watcher(sess))
+                      for _ in range(lo, min(lo + 128, sockets))]
+            await asyncio.sleep(0)
+        pubs0 = server._hub.publishes
+        wake0 = _counter_value(metrics.RELAY_WAKEUPS, proto="sse")
+        deadline = genesis + (rounds + 3) * period
+        held = server._hub.watcher_count()
+        while time.time() < deadline and \
+                sum(1 for t in tasks if t.done()) < len(tasks):
+            held = max(held, server._hub.watcher_count())
+            await asyncio.sleep(0.25)
+        stop.set()
+        server._hub.close_all()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        pubs = server._hub.publishes - pubs0
+        wakeups_sse = _counter_value(metrics.RELAY_WAKEUPS,
+                                     proto="sse") - wake0
+
+        # --- shed correctness on a capped sibling server
+        shed_server = PublicServer(Upstream(), max_watchers=4)
+        shed_site = await shed_server.start("127.0.0.1", 0)
+        shed_port = shed_site._server.sockets[0].getsockname()[1]
+        shed_url = f"http://127.0.0.1:{shed_port}/public/latest"
+        shed0 = _counter_value(metrics.RELAY_SHED, reason="watcher_cap")
+        headers = {"Accept": "text/event-stream"}
+        heldresps = [await sess.get(shed_url, headers=headers)
+                     for _ in range(4)]
+        shed_ok = all(r.status == 200 for r in heldresps)
+        for _ in range(5):
+            r = await sess.get(shed_url, headers=headers)
+            retry_after = int(r.headers.get("Retry-After", "0"))
+            shed_ok = shed_ok and r.status == 429 \
+                and 1 <= retry_after <= period
+            r.close()
+        sheds = _counter_value(metrics.RELAY_SHED,
+                               reason="watcher_cap") - shed0
+        shed_ok = shed_ok and sheds == 5
+        for r in heldresps:
+            r.close()
+        await sess.close()
+        await shed_server.stop()
+        await server.stop()
+        return held, counts, pubs, wakeups_sse, shed_ok, sheds
+
+    held, counts, pubs, wakeups_sse, shed_ok, sheds = asyncio.run(run())
+    complete = sum(1 for c in counts if c >= rounds - 1)
+    if complete < (watchers * 95) // 100:
+        raise RuntimeError(
+            f"fanout inconclusive: only {complete}/{watchers} watchers "
+            f"saw >= {rounds - 1} rounds")
+    if not deliveries:
+        raise RuntimeError("fanout measured no deliveries")
+    deliveries.sort()
+    p50 = deliveries[len(deliveries) // 2]
+    p99 = deliveries[(len(deliveries) * 99) // 100]
+    return {"metric": "relay_fanout",
+            "value": round(pubs / max(1, rounds), 2),
+            "unit": "wakeups_per_round",
+            "watchers": watchers, "socket_watchers": sockets,
+            "held_concurrently": held,
+            "rounds": rounds, "period_s": period,
+            "publishes": pubs,
+            "sse_wakeups_per_round": round(
+                wakeups_sse / max(1, pubs), 2),
+            "deliveries": len(deliveries),
+            "p50_boundary_to_delivery_s": round(p50, 4),
+            "p99_boundary_to_delivery_s": round(p99, 4),
+            "watchers_complete": complete,
+            "shed_requests": sheds, "shed_ok": shed_ok,
+            "vs_baseline": None}
+
+
+def _counter_value(counter, **labels) -> float:
+    return counter.labels(**labels)._value.get()
+
+
+def bench_segment_store(trials):
+    """Segment-vs-SQLite chain store read throughput at 1M-round depth
+    (ISSUE 14): build the SAME synthetic chain in both backends, then
+    measure `cursor_from` streaming from deep offsets (the catch-up /
+    relay-archive serving pattern) and random `get` at depth. The
+    segment store's fixed-width arithmetic addressing must be >= 2x the
+    SQLite B-tree + hex-JSON path on the cursor walk. Host-only, runs
+    FIRST before backend init; the chains live in a temp dir and are
+    deleted afterwards (~1 GiB transient)."""
+    import shutil
+    import tempfile
+
+    from drand_tpu.chain.beacon import Beacon
+    from drand_tpu.chain.segments import SegmentStore
+    from drand_tpu.chain.store import SQLiteStore
+
+    depth = int(os.environ.get("BENCH_SEGSTORE_DEPTH", "1000000"))
+    read_n = min(int(os.environ.get("BENCH_SEGSTORE_READ", "200000")),
+                 depth)
+
+    def synth(n):
+        prev = b""
+        for r in range(n):
+            sig = bytes(((r + i) % 251 for i in range(4))) * 24
+            yield Beacon(round=r, previous_sig=prev, signature=sig,
+                         signature_v2=sig)
+            prev = sig
+
+    tmp = tempfile.mkdtemp(prefix="drand-segstore-bench-")
+    try:
+        seg = SegmentStore(os.path.join(tmp, "segments"))
+        t0 = time.perf_counter()
+        seg.put_many(synth(depth))
+        build_seg = time.perf_counter() - t0
+        sq = SQLiteStore(os.path.join(tmp, "chain.db"))
+        t0 = time.perf_counter()
+        sq.put_many(synth(depth))
+        build_sq = time.perf_counter() - t0
+        log(f"  built {depth} rounds: segment {build_seg:.1f}s, "
+            f"sqlite {build_sq:.1f}s")
+
+        def timed_cursor(store):
+            def run():
+                t0 = time.perf_counter()
+                n = sum(1 for _ in store.cursor_from(depth - read_n))
+                dt = time.perf_counter() - t0
+                if n != read_n:
+                    raise RuntimeError(f"cursor yielded {n} != {read_n}")
+                return dt
+            return run
+
+        trials = max(1, min(trials, 2))
+        dt_seg = best_of(trials, timed_cursor(seg))
+        dt_sq = best_of(trials, timed_cursor(sq))
+
+        import random as _random
+        rng = _random.Random(7)
+        sample = [rng.randrange(depth) for _ in range(2000)]
+
+        def timed_gets(store):
+            t0 = time.perf_counter()
+            for r in sample:
+                if store.get(r) is None:
+                    raise RuntimeError(f"round {r} missing")
+            return time.perf_counter() - t0
+
+        get_seg = timed_gets(seg)
+        get_sq = timed_gets(sq)
+        seg.close()
+        sq.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {"metric": "segment_store_speedup",
+            "value": round(dt_sq / dt_seg, 2), "unit": "x",
+            "depth_rounds": depth, "cursor_read_rounds": read_n,
+            "segment_rounds_per_sec": round(read_n / dt_seg),
+            "sqlite_rounds_per_sec": round(read_n / dt_sq),
+            "segment_gets_per_sec": round(len(sample) / get_seg),
+            "sqlite_gets_per_sec": round(len(sample) / get_sq),
+            "build_seconds": {"segment": round(build_seg, 1),
+                              "sqlite": round(build_sq, 1)},
+            "vs_baseline": None}
+
+
 def bench_sharded_catchup(budget_left):
     """Mesh-sharded wire-RLC catch-up on the virtual CPU mesh, driven
     through the driver's dryrun_multichip (per-shard device h2c +
@@ -989,8 +1286,8 @@ def main() -> None:
     t_start = time.perf_counter()
     which = os.environ.get(
         "BENCH_CONFIGS",
-        "msm,glv4,rlc,obs,flight,chaos,timelock,shard,e2e,catchup,recover,"
-        "deal,replay,headline").split(",")
+        "msm,glv4,rlc,obs,flight,chaos,timelock,fanout,segstore,shard,"
+        "e2e,catchup,recover,deal,replay,headline").split(",")
 
     # --- outage-proofing (round-3 lesson: the official record must never
     # be an unparseable traceback). Two layers:
@@ -1122,6 +1419,30 @@ def main() -> None:
 
             log(traceback.format_exc())
             diag("aux_config_failed", config="timelock",
+                 error=f"{type(e).__name__}: {e}")
+
+    if "fanout" in which:
+        log("== relay fan-out: 10k watchers x 10 rounds, wakeups + "
+            "delivery p99 + shed correctness (host-only) ==")
+        try:
+            emit(bench_relay_fanout(trials))
+        except Exception as e:  # noqa: BLE001 — best-effort aux config
+            import traceback
+
+            log(traceback.format_exc())
+            diag("aux_config_failed", config="fanout",
+                 error=f"{type(e).__name__}: {e}")
+
+    if "segstore" in which:
+        log("== segment-vs-sqlite chain store reads at 1M-round depth "
+            "(host-only) ==")
+        try:
+            emit(bench_segment_store(trials))
+        except Exception as e:  # noqa: BLE001 — best-effort aux config
+            import traceback
+
+            log(traceback.format_exc())
+            diag("aux_config_failed", config="segstore",
                  error=f"{type(e).__name__}: {e}")
 
     if "shard" in which:

@@ -51,6 +51,16 @@ class Store:
         """Rollback support (`drand util del-beacon`, cli.go:651)."""
         raise NotImplementedError
 
+    def put_many(self, beacons) -> int:
+        """Bulk append (migration, archives, synthetic chains). The
+        default is a put() loop so every decorator's hooks and guards
+        still run; backends override with batched writes."""
+        n = 0
+        for b in beacons:
+            self.put(b)
+            n += 1
+        return n
+
     def close(self) -> None:
         pass
 
@@ -179,10 +189,13 @@ class SQLiteStore(Store):
         return Beacon.unmarshal(row[0])
 
     def get(self, round_no: int) -> Beacon | None:
+        from .. import metrics
+
         with self._lock:
             row = self._conn.execute(
                 "SELECT data FROM beacons WHERE round = ?", (round_no,)
             ).fetchone()
+        metrics.CHAIN_STORE_READS.labels(backend="sqlite").inc()
         return None if row is None else Beacon.unmarshal(row[0])
 
     def cursor(self) -> Iterator[Beacon]:
@@ -191,6 +204,8 @@ class SQLiteStore(Store):
     def cursor_from(self, from_round: int, batch: int = 512) -> Iterator[Beacon]:
         """Streams in batches: a sync of a multi-million-round chain must not
         materialize it in memory or hold the lock for the whole walk."""
+        from .. import metrics
+
         next_round = from_round
         while True:
             with self._lock:
@@ -201,9 +216,27 @@ class SQLiteStore(Store):
                 ).fetchall()
             if not rows:
                 return
+            metrics.CHAIN_STORE_READS.labels(backend="sqlite").inc(len(rows))
             for r, data in rows:
                 yield Beacon.unmarshal(data)
             next_round = rows[-1][0] + 1
+
+    def put_many(self, beacons) -> int:
+        """Bulk insert in chunked transactions — a 1M-round migration
+        must not fsync per round."""
+        n = 0
+        it = iter(beacons)
+        while True:
+            chunk = [(b.round, b.marshal())
+                     for _, b in zip(range(4096), it)]
+            if not chunk:
+                return n
+            with self._lock:
+                self._conn.executemany(
+                    "INSERT OR REPLACE INTO beacons (round, data) "
+                    "VALUES (?, ?)", chunk)
+                self._conn.commit()
+            n += len(chunk)
 
     def del_round(self, round_no: int) -> None:
         with self._lock:
@@ -222,6 +255,46 @@ class SQLiteStore(Store):
     def close(self) -> None:
         with self._lock:
             self._conn.close()
+
+
+def _chain_backend(db_path: str) -> tuple[str, str]:
+    """``(backend, path)`` resolution shared by the factory and the
+    existence probe — the ONE place that knows the DRAND_TPU_STORE
+    default and the segments-dir layout, so the offline CLI commands
+    (del-beacon) always probe exactly what the factory opens."""
+    import os
+
+    if os.environ.get("DRAND_TPU_STORE", "sqlite") == "segment":
+        return "segment", os.path.join(os.path.dirname(db_path),
+                                       "segments")
+    return "sqlite", db_path
+
+
+def open_chain_store(db_path: str) -> Store:
+    """The daemon/CLI chain-store factory. SQLite is the default;
+    ``DRAND_TPU_STORE=segment`` selects the packed per-epoch segment
+    backend (chain/segments.py) in a ``segments/`` directory next to
+    the SQLite path — `drand-tpu util store-migrate` converts an
+    existing chain between the two."""
+    backend, path = _chain_backend(db_path)
+    if backend == "segment":
+        from .segments import SegmentStore
+
+        return SegmentStore(path)
+    return SQLiteStore(path)
+
+
+def chain_store_exists(db_path: str) -> tuple[bool, str]:
+    """``(exists, path)`` for the backend :func:`open_chain_store`
+    would open for ``db_path``."""
+    import os
+
+    backend, path = _chain_backend(db_path)
+    if backend == "segment":
+        from .segments import META_FILE
+
+        return os.path.isfile(os.path.join(path, META_FILE)), path
+    return os.path.isfile(path), path
 
 
 class AppendStore(WrappedStore):

@@ -573,16 +573,21 @@ def cmd_util(args) -> None:
         return
     if args.what == "del-beacon":
         # offline rollback (reference cli.go:651 deleteBeaconCmd): daemon
-        # must be stopped; removes every round >= --round
+        # must be stopped; removes every round >= --round. Honors the
+        # store backend the daemon would open (DRAND_TPU_STORE) — a
+        # rollback against the wrong backend would print success while
+        # the chain the daemon serves stays untouched.
         if args.round is None:
             raise SystemExit("del-beacon requires --round (every round >= "
                              "it is deleted)")
-        from ..chain.store import SQLiteStore, StoreError
+        from ..chain.store import (StoreError, chain_store_exists,
+                                   open_chain_store)
 
         db = os.path.join(_folder(args), "db", "chain.db")
-        if not os.path.isfile(db):
-            raise SystemExit(f"no chain db at {db}")
-        store = SQLiteStore(db)
+        exists, chain_path = chain_store_exists(db)
+        if not exists:
+            raise SystemExit(f"no chain store at {chain_path}")
+        store = open_chain_store(db)
         try:
             last = store.last().round
         except StoreError:
@@ -619,6 +624,58 @@ def cmd_util(args) -> None:
             removed.append("db/")
         print(json.dumps({"reset": True, "removed": removed,
                           "folder": folder}))
+        return
+    if args.what == "store-migrate":
+        # SQLite chain db <-> packed segment store (chain/segments.py).
+        # Daemon must be stopped. Default direction is sqlite->segment;
+        # --reverse converts a segment store back into a SQLite db.
+        # The copy is verified (count + head + sampled rounds) before
+        # the command reports success.
+        from ..chain.segments import SegmentStore, migrate_store
+        from ..chain.store import SQLiteStore, StoreError
+
+        from ..chain.segments import META_FILE
+
+        db = args.db or os.path.join(_folder(args), "db", "chain.db")
+        out = args.out or os.path.join(os.path.dirname(db), "segments")
+        if args.reverse:
+            # the SOURCE must already exist in both directions — a
+            # typo'd path would otherwise auto-create an empty store
+            # and report a successful 0-round migration
+            if not os.path.isfile(os.path.join(out, META_FILE)):
+                raise SystemExit(f"no segment store at {out}")
+            src: object = SegmentStore(out)
+            dst: object = SQLiteStore(db)
+        else:
+            if not os.path.isfile(db):
+                raise SystemExit(f"no chain db at {db}")
+            src = SQLiteStore(db)
+            dst = SegmentStore(out)
+        n = migrate_store(src, dst)
+        problems = []
+        if len(dst) != len(src):
+            problems.append(f"count mismatch: src={len(src)} "
+                            f"dst={len(dst)}")
+        try:
+            src_last = src.last()
+            if not dst.last().equal(src_last):
+                problems.append("head beacon mismatch")
+            sample = {0, 1, src_last.round // 2, src_last.round}
+            for rd in sorted(sample):
+                a, b = src.get(rd), dst.get(rd)
+                if (a is None) != (b is None) or \
+                        (a is not None and not a.equal(b)):
+                    problems.append(f"round {rd} mismatch")
+        except StoreError:
+            pass  # empty chain: nothing beyond the count to verify
+        src.close()
+        dst.close()
+        if problems:
+            raise SystemExit("store-migrate verification failed: "
+                             + "; ".join(problems))
+        print(json.dumps({"migrated": n, "db": db, "segments": out,
+                          "direction": ("segment->sqlite" if args.reverse
+                                        else "sqlite->segment")}))
         return
     if args.what == "self-sign":
         from ..key.store import FileStore
@@ -685,9 +742,21 @@ def cmd_analyze(args) -> None:
 
 def cmd_relay(args) -> None:
     """HTTP CDN relay (reference cmd/relay): serve the public API backed by
-    the VERIFIED client stack over one or more origin nodes."""
+    the VERIFIED client stack over one or more origin nodes.
+
+    ``--workers K`` forks K INDEPENDENT worker processes sharing the
+    listen port via SO_REUSEPORT (one event loop caps a box; the kernel
+    load-balances new connections). Each worker runs its own watch loop
+    and fan-out hub; a worker dying takes only its own watchers down.
+    SIGTERM drains gracefully: open /public/latest streams end at the
+    hub sentinel before the listener closes."""
+    if args.workers > 1:
+        _relay_parent(args)
+        return
 
     async def run():
+        import signal
+
         from ..client import new_client
         from ..client.http import HTTPClient
         from ..http_server.server import PublicServer
@@ -702,13 +771,95 @@ def cmd_relay(args) -> None:
 
             tl_service = TimelockService(TimelockVault(args.timelock_db),
                                          client)
-        server = PublicServer(client, timelock_service=tl_service)
+        server = PublicServer(
+            client, timelock_service=tl_service,
+            timelock_sweep=not args.no_timelock_sweep)
         host, port = args.listen.rsplit(":", 1)
-        await server.start(host or "0.0.0.0", int(port))
-        print(f"relay serving {args.listen} from {args.url}", flush=True)
-        await asyncio.Event().wait()
+        await server.start(host or "0.0.0.0", int(port),
+                           reuse_port=args.reuse_port)
+        print(f"relay serving {args.listen} from {args.url} "
+              f"pid={os.getpid()}", flush=True)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        print(f"relay pid={os.getpid()} draining", flush=True)
+        await server.stop()
+        await client.close()
 
     asyncio.run(run())
+
+
+def _relay_parent(args) -> None:
+    """Supervise ``--workers K`` SO_REUSEPORT relay workers. One worker
+    exiting does NOT take the port down — the survivors keep serving
+    their watchers (the worker-smoke contract); the parent exits when
+    every worker has. SIGTERM/SIGINT fan out to the workers so the
+    whole group drains together."""
+    import signal
+    import subprocess
+    import time as _time
+
+    argv = [sys.executable, "-m", "drand_tpu.cli", "relay",
+            "--url", args.url, "--listen", args.listen,
+            "--workers", "1", "--reuse-port"]
+    if args.chain_hash:
+        argv += ["--chain-hash", args.chain_hash]
+    if args.insecure:
+        argv += ["--insecure"]
+    if args.timelock_db:
+        argv += ["--timelock-db", args.timelock_db]
+    def _spawn(sweeper: bool):
+        worker_argv = list(argv)
+        if args.timelock_db and not sweeper:
+            # ONE designated sweeping worker: all workers serve the
+            # vault routes from the shared file, but only the sweeper
+            # opens rounds at boundaries — K concurrent sweeps would
+            # recompute the same pairing-class openings K times and
+            # contend on one WAL file every round
+            worker_argv.append("--no-timelock-sweep")
+        return subprocess.Popen(worker_argv)
+
+    procs = [_spawn(sweeper=(i == 0)) for i in range(args.workers)]
+    sweeper = procs[0]
+    crashed = False
+    stopping = False
+    print(f"relay parent pid={os.getpid()} workers="
+          f"{[p.pid for p in procs]}", flush=True)
+
+    def _fan_out(signum, frame):
+        nonlocal stopping
+        stopping = True
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+
+    signal.signal(signal.SIGTERM, _fan_out)
+    signal.signal(signal.SIGINT, _fan_out)
+    respawns = 0
+    while any(p.poll() is None for p in procs):
+        # a dead SWEEPER would silently stop vault round-opens while
+        # the survivors keep serving — respawn it (bounded: a
+        # crash-looping sweeper must not fork-bomb the box)
+        if (args.timelock_db and not stopping
+                and sweeper.poll() is not None
+                and any(p.poll() is None for p in procs)
+                and respawns < 5):
+            respawns += 1
+            old_rc = sweeper.returncode
+            crashed = crashed or old_rc != 0
+            sweeper = _spawn(sweeper=True)
+            procs.append(sweeper)
+            print(f"relay parent: sweeper died (rc={old_rc}), "
+                  f"respawned pid={sweeper.pid} ({respawns}/5)",
+                  flush=True)
+        _time.sleep(0.2)
+    # any worker that did not exit cleanly — including signal deaths,
+    # whose returncode is NEGATIVE — must surface to the supervisor;
+    # max() would mask a segfaulted worker behind the clean drains
+    raise SystemExit(
+        0 if all(p.returncode == 0 for p in procs) and not crashed else 1)
 
 
 def _client_trust(args) -> dict:
@@ -1077,7 +1228,7 @@ def main(argv=None) -> None:
     u = sub.add_parser("util")
     u.add_argument("what", choices=["ping", "check", "del-beacon",
                                     "self-sign", "reset", "trace",
-                                    "engine", "flight"])
+                                    "engine", "flight", "store-migrate"])
     u.add_argument("--control", type=int, default=8888)
     u.add_argument("--address")
     u.add_argument("--folder")
@@ -1095,6 +1246,15 @@ def main(argv=None) -> None:
     u.add_argument("--dkg", action="store_true",
                    help="flight: show the DKG phase timeline instead "
                         "of the round matrix")
+    u.add_argument("--db", default="",
+                   help="store-migrate: SQLite chain db path "
+                        "(default <folder>/db/chain.db)")
+    u.add_argument("--out", default="",
+                   help="store-migrate: segment store directory "
+                        "(default <db dir>/segments)")
+    u.add_argument("--reverse", action="store_true",
+                   help="store-migrate: convert segment->sqlite "
+                        "instead of sqlite->segment")
     u.add_argument("--json", action="store_true",
                    help="raw JSON instead of the pretty rendering "
                         "(trace/engine/flight)")
@@ -1132,6 +1292,14 @@ def main(argv=None) -> None:
     r.add_argument("--timelock-db", default="",
                    help="serve the timelock vault from this sqlite path "
                         "(opens rounds off the verified watch stream)")
+    r.add_argument("--workers", type=int, default=1,
+                   help="fork this many SO_REUSEPORT worker processes "
+                        "sharing the listen port (each with its own "
+                        "event loop, watch loop and fan-out hub)")
+    r.add_argument("--reuse-port", action="store_true",
+                   help=argparse.SUPPRESS)  # set by the worker parent
+    r.add_argument("--no-timelock-sweep", action="store_true",
+                   help=argparse.SUPPRESS)  # parent designates sweeper
     r.set_defaults(fn=cmd_relay)
 
     tl = sub.add_parser("timelock",

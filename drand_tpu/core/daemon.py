@@ -19,7 +19,7 @@ from typing import AsyncIterator
 
 from ..chain.beacon import Beacon
 from ..chain.engine.handler import BeaconConfig, Handler
-from ..chain.store import MemStore, SQLiteStore, Store
+from ..chain.store import MemStore, Store, open_chain_store
 from ..dkg import BroadcastBoard, DKGConfig, DKGError, DKGProtocol, DistKeyShare
 from ..key.group import Group
 from ..key.keys import Node, Pair, Share
@@ -308,7 +308,7 @@ class Drand(ProtocolService):
         db = self.conf.db_file()
         if db:
             os.makedirs(os.path.dirname(db), exist_ok=True)
-            store: Store = SQLiteStore(db)
+            store: Store = open_chain_store(db)
         else:
             store = MemStore()
         store.put(genesis_beacon(info))
@@ -462,7 +462,7 @@ class Drand(ProtocolService):
         db = self.conf.db_file()
         if db:
             os.makedirs(os.path.dirname(db), exist_ok=True)
-            store: Store = SQLiteStore(db)
+            store: Store = open_chain_store(db)
         else:
             store = MemStore()
         bconf = BeaconConfig(public=Node(identity=self.priv.public,

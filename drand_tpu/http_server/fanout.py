@@ -1,0 +1,179 @@
+"""Round-boundary fan-out hub for `/public/latest` watchers (ISSUE 14).
+
+The poll/long-poll serving model costs one handler invocation — or one
+held connection slot — per watcher per round. This hub inverts it: the
+watch loop publishes each new round ONCE, the payload is serialized
+ONCE per stream protocol, and every subscribed connection receives the
+pre-framed bytes through its own small bounded queue. N watchers cost
+one wakeup per round (per protocol), not N polls.
+
+Backpressure is explicit, never unbounded: a subscriber whose queue is
+full when a round is published (a consumer slower than the round
+period times the queue depth) is DISCONNECTED — its queue is drained
+and replaced with the close sentinel, and `relay_shed_total
+{reason="slow_consumer"}` counts it. A beacon is ~300 bytes of JSON at
+one frame per period; any real consumer drains instantly, so a full
+queue means a dead or wedged peer holding server memory.
+
+Protocol framing (both carry the same `/public/latest` JSON object):
+
+- ``sse``    — ``text/event-stream``: ``id: <round>`` + ``data: <json>``
+  frames, consumable by every EventSource client.
+- ``ndjson`` — ``application/x-ndjson``: one JSON object per line over
+  a chunked response.
+
+Single-threaded by design: subscribe/publish/unsubscribe all run on
+the serving event loop (the aiohttp handlers and the watch loop), so
+the subscriber set needs no lock — the analyzer's threadshare pass
+holds this by construction (nothing here is reached from a thread).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+PROTO_SSE = "sse"
+PROTO_NDJSON = "ndjson"
+
+# per-connection queue depth: one beacon frame per round means depth 4
+# tolerates a consumer a few periods behind before it is shed
+DEFAULT_QUEUE_MAX = 4
+
+
+def _wakeup_counter(proto: str):
+    """Branch-literal proto labels (check_metrics KNOWN_LABEL_VALUES)."""
+    from .. import metrics
+
+    if proto == PROTO_SSE:
+        return metrics.RELAY_WAKEUPS.labels(proto="sse")
+    return metrics.RELAY_WAKEUPS.labels(proto="ndjson")
+
+
+def sse_frame(round_no: int, payload: bytes) -> bytes:
+    """One SSE event; ``id`` carries the round so reconnecting clients
+    know where they left off (Last-Event-ID semantics are the client's
+    to use — rounds are fetchable by number from `/public/{round}`)."""
+    return b"id: %d\ndata: %s\n\n" % (round_no, payload)
+
+
+def ndjson_frame(payload: bytes) -> bytes:
+    return payload + b"\n"
+
+
+class Subscription:
+    """One watcher connection's end of the hub: a bounded queue of
+    ``(round, framed bytes)`` items — the round rides along so a
+    consumer that wrote a connect-time snapshot can skip a publish of
+    the same round that raced in while its response was being
+    prepared. ``None`` from :meth:`next` means the stream is over —
+    the hub shed this subscriber or the server is draining."""
+
+    __slots__ = ("proto", "_queue", "shed")
+
+    def __init__(self, proto: str, queue_max: int):
+        self.proto = proto
+        # asyncio.Queue(0) means UNBOUNDED — exactly the failure mode
+        # this hub exists to rule out; clamp to at least one slot
+        self._queue: asyncio.Queue = asyncio.Queue(max(1, queue_max))
+        self.shed = False
+
+    async def next(self) -> tuple[int, bytes] | None:
+        return await self._queue.get()
+
+    def _push(self, item: tuple[int, bytes]) -> bool:
+        try:
+            self._queue.put_nowait(item)
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    def _close(self) -> None:
+        """Drain + sentinel: the consumer wakes to None and ends the
+        response. Runs only from the publishing loop (no await between
+        the drain and the put, so the consumer cannot interleave a get
+        that would let the sentinel put fail)."""
+        while True:
+            try:
+                self._queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+        self._queue.put_nowait(None)
+
+
+class FanoutHub:
+    """Publish-once round broadcast to bounded per-connection queues."""
+
+    def __init__(self, queue_max: int = DEFAULT_QUEUE_MAX):
+        self._queue_max = queue_max
+        self._subs: set[Subscription] = set()
+        self.publishes = 0  # rounds published (the per-worker wakeup meter)
+
+    # --------------------------------------------------------- membership
+    def watcher_count(self) -> int:
+        return len(self._subs)
+
+    def subscribe(self, proto: str) -> Subscription:
+        from .. import metrics
+
+        sub = Subscription(proto, self._queue_max)
+        self._subs.add(sub)
+        metrics.RELAY_WATCHERS.set(len(self._subs))
+        return sub
+
+    def unsubscribe(self, sub: Subscription) -> None:
+        from .. import metrics
+
+        self._subs.discard(sub)
+        metrics.RELAY_WATCHERS.set(len(self._subs))
+
+    # ---------------------------------------------------------- publishing
+    def publish(self, result_dict: dict, round_no: int,
+                boundary_delay_s: float | None = None) -> int:
+        """Fan one round out to every subscriber. The JSON payload is
+        serialized once, framed once per protocol that has subscribers,
+        and delivered by reference — per-watcher cost is one queue put.
+        Returns the number of subscribers reached."""
+        from .. import metrics
+
+        self.publishes += 1
+        if boundary_delay_s is not None:
+            metrics.RELAY_BOUNDARY_DELIVERY.observe(
+                max(0.0, boundary_delay_s))
+        if not self._subs:
+            return 0
+        payload = json.dumps(result_dict).encode()
+        frames: dict[str, bytes] = {}
+        woken: set[str] = set()
+        reached = 0
+        for sub in list(self._subs):
+            frame = frames.get(sub.proto)
+            if frame is None:
+                frame = (sse_frame(round_no, payload)
+                         if sub.proto == PROTO_SSE
+                         else ndjson_frame(payload))
+                frames[sub.proto] = frame
+            if sub._push((round_no, frame)):
+                reached += 1
+                woken.add(sub.proto)
+            else:
+                # slow consumer: bounded send queues mean we disconnect,
+                # never buffer unboundedly
+                sub.shed = True
+                sub._close()
+                self._subs.discard(sub)
+                metrics.RELAY_SHED.labels(reason="slow_consumer").inc()
+        metrics.RELAY_WATCHERS.set(len(self._subs))
+        for proto in woken:
+            _wakeup_counter(proto).inc()
+        return reached
+
+    def close_all(self) -> None:
+        """Graceful drain: every open stream ends cleanly (the SIGTERM
+        path — workers stop accepting, then close watchers)."""
+        from .. import metrics
+
+        for sub in list(self._subs):
+            sub._close()
+        self._subs.clear()
+        metrics.RELAY_WATCHERS.set(0)

@@ -20,6 +20,8 @@ from __future__ import annotations
 
 import asyncio
 import json
+import math
+import os
 
 from aiohttp import web
 
@@ -27,6 +29,40 @@ from ..chain import time_math
 from ..client.interface import Client, ClientError, Result
 from ..utils.clock import Clock, SystemClock
 from ..utils.logging import KVLogger, default_logger
+from ..utils.retry import RetryPolicy, retry
+from . import fanout
+
+# watch-loop restart policy: decorrelated jitter on the INJECTABLE
+# clock (the raw `await asyncio.sleep(1.0)` it replaces was invisible
+# to FakeClock runs and hammered a dead upstream at a fixed rate).
+# attempts bounds one retry() cycle; the loop re-enters on exhaustion,
+# so a dead upstream is probed ~attempts times per backoff ramp forever.
+_WATCH_RETRY = RetryPolicy(attempts=6, base_s=0.5, cap_s=15.0)
+
+# connection cap for `/public/latest` stream watchers: a cheap
+# counter check before ANY handler work (each watcher holds one fd;
+# shedding at the door is what keeps an overload from starving the
+# poll handlers sharing the loop)
+DEFAULT_MAX_WATCHERS = int(os.environ.get(
+    "DRAND_TPU_RELAY_MAX_WATCHERS", "4096"))
+
+
+def _etag_matches(if_none_match: str | None, etag: str) -> bool:
+    """RFC 7232 If-None-Match: member-wise WEAK comparison — caches
+    legitimately send lists (`"r99", "r100"`), weak validators
+    (`W/"r100"`), or `*`; exact string equality would silently defeat
+    the 304 path for exactly the shared caches the ETag targets."""
+    if not if_none_match:
+        return False
+    if if_none_match.strip() == "*":
+        return True
+    for member in if_none_match.split(","):
+        member = member.strip()
+        if member.startswith("W/"):
+            member = member[2:]
+        if member == etag:
+            return True
+    return False
 
 
 def result_json(r: Result) -> dict:
@@ -47,7 +83,10 @@ class PublicServer:
                  watch_timeout: float = 30.0,
                  peer_metrics_fn=None,
                  enable_pprof: bool = False,
-                 timelock_service=None):
+                 timelock_service=None,
+                 timelock_sweep: bool = True,
+                 max_watchers: int | None = None,
+                 fanout_queue_max: int = fanout.DEFAULT_QUEUE_MAX):
         self._client = client
         self._clock = clock or SystemClock()
         self._l = logger or default_logger("http")
@@ -57,12 +96,22 @@ class PublicServer:
         self._peer_metrics_fn = peer_metrics_fn
         # optional timelock vault front (drand_tpu/timelock): adds the
         # submit/status routes and opens pending ciphertexts from the
-        # watch loop's round boundary (covers relays with no store hook)
+        # watch loop's round boundary (covers relays with no store
+        # hook). timelock_sweep=False serves the vault routes WITHOUT
+        # sweeping at boundaries — the non-designated members of a
+        # multi-worker relay group sharing one vault file (one sweeper
+        # avoids K workers re-opening the same rounds concurrently)
         self._timelock = timelock_service
+        self._timelock_sweep = timelock_sweep
         self._latest: Result | None = None
         self._next_round_event = asyncio.Event()
         self._watch_task: asyncio.Task | None = None
         self._chain_tag: bytes | None = None
+        # push tier (ISSUE 14): SSE / NDJSON watchers on /public/latest
+        # share one broadcast hub — one publish per round, not N polls
+        self._hub = fanout.FanoutHub(queue_max=fanout_queue_max)
+        self._max_watchers = (max_watchers if max_watchers is not None
+                              else DEFAULT_MAX_WATCHERS)
         # last successfully fetched chain info: the stale-serving path
         # computes the X-Drand-Stale lag from it after the upstream dies
         self._info_cache = None
@@ -87,8 +136,6 @@ class PublicServer:
         # group file) but operators can opt out with
         # DRAND_TPU_TRACE_DEBUG=0; the pprof routes stay opt-in like
         # the reference (pprof.go WithProfile)
-        import os
-
         if os.environ.get("DRAND_TPU_TRACE_DEBUG", "1") != "0":
             from .debug import add_trace_routes
 
@@ -99,13 +146,21 @@ class PublicServer:
             add_debug_routes(self.app)
 
     # ------------------------------------------------------------ serving
-    async def start(self, host: str, port: int) -> web.TCPSite:
+    async def start(self, host: str, port: int,
+                    reuse_port: bool = False) -> web.TCPSite:
+        """``reuse_port=True`` lets K relay worker processes share one
+        listen port via SO_REUSEPORT (`drand-tpu relay --workers K`) —
+        the kernel load-balances new connections across workers, each
+        of which runs its own event loop, watch loop and fan-out hub."""
         self._watch_task = asyncio.ensure_future(self._watch_loop())
         if self._timelock is not None:
             await self._timelock.start()
-        runner = web.AppRunner(self.app)
+        # short shutdown grace: draining streams end at the hub sentinel,
+        # so nothing needs aiohttp's default 60 s lingering-handler wait
+        runner = web.AppRunner(self.app, shutdown_timeout=5.0)
         await runner.setup()
-        site = web.TCPSite(runner, host, port)
+        site = web.TCPSite(runner, host, port,
+                           reuse_port=reuse_port or None)
         await site.start()
         self._runner = runner
         return site
@@ -113,30 +168,59 @@ class PublicServer:
     async def stop(self) -> None:
         if self._watch_task is not None:
             self._watch_task.cancel()
-        # stop accepting requests BEFORE closing the vault: an in-flight
-        # submit against a closed sqlite handle would 500 instead of
-        # being refused cleanly
+        # graceful drain order: close the watcher streams FIRST (each
+        # handler wakes to the hub sentinel and finishes its response),
+        # then stop accepting requests, then close the vault — an
+        # in-flight submit against a closed sqlite handle would 500
+        # instead of being refused cleanly
+        self._hub.close_all()
         await self._runner.cleanup()
         if self._timelock is not None:
             await self._timelock.close()
 
     async def _watch_loop(self) -> None:
-        """Track the tip so /public/{next} can long-poll (server.go:102)."""
+        """Track the tip so /public/{next} can long-poll (server.go:102)
+        and feed the fan-out hub. Restarts ride the injectable-clock
+        retry policy (decorrelated jitter) instead of a raw
+        asyncio.sleep — the analyzer's retry-sleep rule covers
+        http_server/ like net/ and chain/."""
         while True:
             try:
-                async for r in self._client.watch():
-                    self._latest = r
-                    self._next_round_event.set()
-                    self._next_round_event = asyncio.Event()
-                    if self._timelock is not None:
-                        # round boundary: open the round's pending
-                        # timelock ciphertexts (one batched dispatch)
-                        self._timelock.on_result(r)
+                await retry(self._watch_pass, op="watch",
+                            policy=_WATCH_RETRY, clock=self._clock)
             except asyncio.CancelledError:
                 return
             except Exception as e:  # noqa: BLE001 — keep serving
                 self._l.warn("http", "watch_restart", err=str(e))
-                await asyncio.sleep(1.0)
+
+    async def _watch_pass(self) -> None:
+        # refresh the info cache first: the stale-lag and Retry-After
+        # math need period/genesis, and a dead upstream fails fast here
+        # instead of inside the watch iterator
+        try:
+            await self._get_info()
+        except ClientError:
+            pass  # tolerated: some test doubles serve watch() only
+        async for r in self._client.watch():
+            self._publish(r)
+
+    def _publish(self, r: Result) -> None:
+        """One round boundary: wake the long-pollers, the timelock
+        sweep, and every stream watcher from a single hub publish."""
+        self._latest = r
+        self._next_round_event.set()
+        self._next_round_event = asyncio.Event()
+        if self._timelock is not None and self._timelock_sweep:
+            # round boundary: open the round's pending
+            # timelock ciphertexts (one batched dispatch)
+            self._timelock.on_result(r)
+        delay = None
+        info = self._info_cache
+        if info is not None:
+            boundary = time_math.time_of_round(info.period,
+                                               info.genesis_time, r.round)
+            delay = self._clock.now() - boundary
+        self._hub.publish(result_json(r), r.round, boundary_delay_s=delay)
 
     # ------------------------------------------------------------ handlers
     @web.middleware
@@ -198,11 +282,127 @@ class PublicServer:
         return resp
 
     async def _handle_latest(self, request: web.Request) -> web.Response:
+        proto = self._stream_proto(request)
+        if proto is not None:
+            return await self._handle_latest_stream(request, proto)
         try:
             r = await self._client.get(0)
         except ClientError as e:
             return await self._stale_or_error(e)
-        return await self._result_response(r)
+        # round-keyed ETag (ISSUE 14 satellite): the pollers that remain
+        # on plain GET revalidate with If-None-Match and cost a header,
+        # not a body, between rounds. no-cache (NOT no-store) so shared
+        # caches may hold the entity but must revalidate it — the round
+        # advances every period. The stale/degraded path above keeps
+        # no-store and never carries an ETag.
+        etag = f'"r{r.round}"'
+        if _etag_matches(request.headers.get("If-None-Match"), etag):
+            return web.Response(status=304, headers={
+                "ETag": etag, "Cache-Control": "no-cache",
+                "Vary": "Accept"})
+        resp = await self._result_response(r)
+        resp.headers["ETag"] = etag
+        resp.headers["Cache-Control"] = "no-cache"
+        # /public/latest is content-negotiated (JSON vs SSE/NDJSON
+        # streams): a shared cache must never serve the JSON entity to
+        # an EventSource client or vice versa
+        resp.headers["Vary"] = "Accept"
+        return resp
+
+    # ------------------------------------------------------------ push tier
+    @staticmethod
+    def _stream_proto(request: web.Request) -> str | None:
+        """Watch-protocol content negotiation on /public/latest: SSE for
+        ``Accept: text/event-stream``, chunked NDJSON for ``Accept:
+        application/x-ndjson``. Plain GET pollers are untouched."""
+        accept = request.headers.get("Accept", "")
+        if "text/event-stream" in accept:
+            return fanout.PROTO_SSE
+        if "application/x-ndjson" in accept:
+            return fanout.PROTO_NDJSON
+        return None
+
+    def _shed_response(self) -> web.Response:
+        """429 + Retry-After aligned to the NEXT round boundary
+        (chain/time_math): a shed watcher that comes back any earlier
+        would only re-join the same queue for the same round — this
+        way the retry lands exactly when there is something new. Uses
+        only the cached chain info: shedding must never cost an
+        upstream fetch."""
+        from .. import metrics
+
+        metrics.RELAY_SHED.labels(reason="watcher_cap").inc()
+        retry_after = 1
+        info = self._info_cache
+        if info is not None:
+            now = self._clock.now()
+            _, next_t = time_math.next_round(int(now), info.period,
+                                             info.genesis_time)
+            retry_after = max(1, math.ceil(next_t - now))
+        return web.json_response(
+            {"error": "watcher capacity reached, retry at the next round"},
+            status=429,
+            headers={"Retry-After": str(retry_after), "Vary": "Accept"})
+
+    async def _handle_latest_stream(self, request: web.Request,
+                                    proto: str) -> web.StreamResponse:
+        """Push-tier /public/latest: subscribe the connection to the
+        fan-out hub and stream rounds as the watch loop publishes them.
+        The initial snapshot (last-known beacon, possibly stale) is
+        framed per-connection; everything after it is the hub's
+        shared-once-per-round payload."""
+        # load shedding happens BEFORE any handler work: one integer
+        # compare guards the fd/queue cost of a new watcher
+        if self._hub.watcher_count() >= self._max_watchers:
+            return self._shed_response()
+        resp = web.StreamResponse()
+        resp.headers["Content-Type"] = (
+            "text/event-stream" if proto == fanout.PROTO_SSE
+            else "application/x-ndjson")
+        resp.headers["Cache-Control"] = "no-store"
+        resp.headers["Vary"] = "Accept"
+        resp.headers["X-Accel-Buffering"] = "no"
+        # the serving worker's pid: lets operators (and the worker
+        # smoke test) see which SO_REUSEPORT worker holds the stream
+        resp.headers["X-Drand-Worker"] = str(os.getpid())
+        # degraded-mode marker at connect time (ISSUE 12 semantics
+        # carried onto streams): when the last-known beacon is behind
+        # the schedule, say by how many rounds
+        info = self._info_cache
+        if info is not None and self._latest is not None:
+            expected = time_math.current_round(
+                int(self._clock.now()), info.period, info.genesis_time)
+            lag = max(0, expected - self._latest.round)
+            if lag > 0:
+                resp.headers["X-Drand-Stale"] = str(lag)
+        sub = self._hub.subscribe(proto)
+        try:
+            await resp.prepare(request)
+            snap_round = -1
+            if self._latest is not None:
+                snap = self._latest
+                snap_round = snap.round
+                payload = json.dumps(result_json(snap)).encode()
+                frame = (fanout.sse_frame(snap.round, payload)
+                         if proto == fanout.PROTO_SSE
+                         else fanout.ndjson_frame(payload))
+                await resp.write(frame)
+            while True:
+                item = await sub.next()
+                if item is None:
+                    break  # shed as a slow consumer, or server drain
+                round_no, frame = item
+                if round_no <= snap_round:
+                    # a publish that raced the prepare() await already
+                    # went out as the snapshot — never send it twice
+                    continue
+                await resp.write(frame)
+            await resp.write_eof()
+        except (ConnectionResetError, ConnectionError):
+            pass  # the client went away mid-stream; nothing to salvage
+        finally:
+            self._hub.unsubscribe(sub)
+        return resp
 
     async def _stale_or_error(self, err: ClientError) -> web.Response:
         """Degraded-mode serving (ISSUE 12): when the upstream is lost
@@ -226,6 +426,7 @@ class PublicServer:
         resp = await self._result_response(self._latest)
         resp.headers["X-Drand-Stale"] = str(lag)
         resp.headers["Cache-Control"] = "no-store"
+        resp.headers["Vary"] = "Accept"
         metrics.RELAY_STALE_SERVED.inc()
         self._l.warn("http", "serving_stale", lag_rounds=lag,
                      round=self._latest.round)

@@ -104,7 +104,7 @@ handler quorum repair, http_server stale serving — ISSUE 12: the
 active-recovery tier the ISSUE-11 fault oracle proved was missing):
   net_retry_attempts_total{op,outcome} [group]   every retry-policy
       attempt by call-site op (partial | sync | repair | control |
-      gossip | timelock) and outcome (ok | retry | exhausted |
+      gossip | timelock | watch) and outcome (ok | retry | exhausted |
       rejected — rejected = classified non-retryable, e.g. the peer
       answered with a reject)
   beacon_peer_breaker_state{index}     [group]   per-peer circuit
@@ -118,6 +118,26 @@ active-recovery tier the ISSUE-11 fault oracle proved was missing):
   relay_stale_served_total             [http]    /public/latest
       responses served from the last-known beacon with the
       X-Drand-Stale header because the upstream was unreachable
+Edge fan-out set (http_server/fanout.py hub + chain/segments.py,
+ISSUE 14 — the push tier on /public/latest and the packed segment
+chain store behind it):
+  relay_watchers                       [http]    currently connected
+      /public/latest stream watchers (SSE + NDJSON) on this worker
+  relay_wakeups_total{proto}           [http]    hub publishes that woke
+      at least one watcher of that protocol (sse | ndjson) — ≤1 per
+      round per protocol per worker, NOT O(watchers); the push-tier
+      cost model in one counter
+  relay_shed_total{reason}             [http]    watcher connections
+      refused or dropped by the load shedder (watcher_cap = 429 at the
+      connection cap with Retry-After on the next round boundary;
+      slow_consumer = bounded send queue overflowed, the stream was
+      disconnected rather than buffered unboundedly)
+  relay_boundary_delivery_seconds      [http]    scheduled round
+      boundary to hub publish on this worker — the server half of
+      boundary-to-delivery latency (the bench measures the client half)
+  chain_store_reads_total{backend}     [group]   beacon reads served by
+      the chain store by backend (sqlite | segment) — the migration
+      observability for the packed segment format
 Engine introspection (ISSUE 6):
   engine_compile_seconds{op}           [private] FIRST dispatch of each
       (op, path, batch-bucket) device shape — the jit compile +
@@ -364,7 +384,8 @@ SYNC_STALLED = Gauge(
 NET_RETRY_ATTEMPTS = Counter(
     "net_retry_attempts_total",
     "Retry-policy attempts by call-site op (partial|sync|repair|"
-    "control|gossip|timelock) and outcome (ok = attempt succeeded; "
+    "control|gossip|timelock|watch) and outcome (ok = attempt "
+    "succeeded; "
     "retry = failed with a backoff sleep following; exhausted = failed "
     "with no budget left; rejected = classified non-retryable)",
     ["op", "outcome"], registry=GROUP_REGISTRY)
@@ -386,6 +407,38 @@ RELAY_STALE_SERVED = Counter(
     "/public/latest responses served from the last-known beacon with "
     "the X-Drand-Stale header because the upstream was unreachable",
     registry=HTTP_REGISTRY)
+
+# ---- edge fan-out push tier (http_server/fanout.py, ISSUE 14) -------------
+RELAY_WATCHERS = Gauge(
+    "relay_watchers",
+    "Currently connected /public/latest stream watchers (SSE + NDJSON) "
+    "on this relay worker process",
+    registry=HTTP_REGISTRY)
+RELAY_WAKEUPS = Counter(
+    "relay_wakeups_total",
+    "Fan-out hub publishes that woke at least one watcher, by stream "
+    "protocol (sse|ndjson) — at most one per round per protocol per "
+    "worker regardless of watcher count",
+    ["proto"], registry=HTTP_REGISTRY)
+RELAY_SHED = Counter(
+    "relay_shed_total",
+    "Stream watchers refused or dropped by the load shedder "
+    "(watcher_cap = 429 at the connection cap, Retry-After on the next "
+    "round boundary; slow_consumer = bounded send queue overflowed and "
+    "the stream was disconnected)",
+    ["reason"], registry=HTTP_REGISTRY)
+RELAY_BOUNDARY_DELIVERY = Histogram(
+    "relay_boundary_delivery_seconds",
+    "Scheduled round boundary to fan-out hub publish on this worker "
+    "(the server half of boundary-to-delivery latency)",
+    registry=HTTP_REGISTRY,
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+             0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0))
+CHAIN_STORE_READS = Counter(
+    "chain_store_reads_total",
+    "Beacon reads served by the chain store, by backend "
+    "(sqlite|segment) — get() and cursor batches both count per beacon",
+    ["backend"], registry=GROUP_REGISTRY)
 
 # ---- OTLP export (obs/export.py) ------------------------------------------
 OTLP_EXPORT_ROUNDS = Counter(

@@ -462,14 +462,25 @@ class GossipRelay:
             self._task.cancel()
 
     async def _run(self) -> None:
+        from ..utils.retry import RetryPolicy, retry
+
+        # restart rides the retry policy (decorrelated jitter) instead
+        # of a raw fixed sleep — the analyzer's retry-sleep rule covers
+        # relay/ like net/ and http_server/ (ISSUE 14). System clock on
+        # purpose, like the gossip forward path: the gossip validation
+        # clock is a per-test fake nobody advances.
+        policy = RetryPolicy(attempts=6, base_s=0.5, cap_s=15.0)
         while True:
             try:
-                async for r in self._src.watch():
-                    await self.node.publish(Beacon(
-                        round=r.round, previous_sig=r.previous_signature,
-                        signature=r.signature,
-                        signature_v2=r.signature_v2))
+                await retry(self._watch_pass, op="gossip", policy=policy)
             except asyncio.CancelledError:
                 return
             except Exception:  # noqa: BLE001 — keep relaying
-                await asyncio.sleep(1.0)
+                continue
+
+    async def _watch_pass(self) -> None:
+        async for r in self._src.watch():
+            await self.node.publish(Beacon(
+                round=r.round, previous_sig=r.previous_signature,
+                signature=r.signature,
+                signature_v2=r.signature_v2))

@@ -34,8 +34,8 @@ Observability: every attempt lands on
   a rejection must not be hammered
 
 ``op`` is the call-site tag (partial | sync | repair | control |
-gossip | timelock) — bounded by the code paths that mint it, like the
-ingress-reject verdict label.
+gossip | timelock | watch) — bounded by the code paths that mint it,
+like the ingress-reject verdict label.
 """
 
 from __future__ import annotations

@@ -75,12 +75,13 @@ def test_loopblock_direct_and_transitive(tmp_path):
 
 
 def test_loopblock_retry_sleep_rule(tmp_path):
-    """ISSUE 12: a raw asyncio.sleep inside a retry/backoff loop (a
-    loop that both handles exceptions and backs off) in net/, chain/
-    or timelock/ is a medium finding — retries there must ride the
-    injectable-clock policy. Cooperative sleep(0) yields, clock-policy
-    sleeps, loops without exception handling, and the same shape
-    OUTSIDE the scoped packages all stay clean."""
+    """ISSUE 12 (scope widened by ISSUE 14): a raw asyncio.sleep inside
+    a retry/backoff loop (a loop that both handles exceptions and backs
+    off) in net/, chain/, timelock/, http_server/ or relay/ is a medium
+    finding — retries there must ride the injectable-clock policy.
+    Cooperative sleep(0) yields, clock-policy sleeps, loops without
+    exception handling, and the same shape OUTSIDE the scoped packages
+    all stay clean."""
     proj = _project(tmp_path, {
         "drand_tpu/net/dialer.py": """
             import asyncio
@@ -111,7 +112,33 @@ def test_loopblock_retry_sleep_rule(tmp_path):
                 while True:
                     await asyncio.sleep(0.5)
         """,
+        # http_server/ and relay/ are IN scope since the relay watch
+        # loop moved onto the policy (ISSUE 14) — the exact shape the
+        # old PublicServer._watch_loop restart path had is now flagged
+        "drand_tpu/http_server/watchish.py": """
+            import asyncio
+
+            async def bad_watch_loop(client):
+                while True:
+                    try:
+                        async for r in client.watch():
+                            pass
+                    except Exception:
+                        await asyncio.sleep(1.0)
+        """,
         "drand_tpu/relay/pump.py": """
+            import asyncio
+
+            async def bad_forward(peer):
+                while True:
+                    try:
+                        return await peer.call()
+                    except ConnectionError:
+                        await asyncio.sleep(0.5)
+        """,
+        # the consuming client stack stays OUT of scope: its poll
+        # cadence is wall-clock by design (client/http.py watch)
+        "drand_tpu/client/poller.py": """
             import asyncio
 
             async def out_of_scope(peer):
@@ -124,8 +151,13 @@ def test_loopblock_retry_sleep_rule(tmp_path):
     })
     findings = [f for f in loopblock.run(proj)
                 if f.rule == "retry-sleep"]
-    assert {f.symbol for f in findings} == {"drand_tpu.net.dialer.bad_dial"}
-    f = findings[0]
+    assert {f.symbol for f in findings} == {
+        "drand_tpu.net.dialer.bad_dial",
+        "drand_tpu.http_server.watchish.bad_watch_loop",
+        "drand_tpu.relay.pump.bad_forward",
+    }
+    f = next(f for f in findings
+             if f.symbol == "drand_tpu.net.dialer.bad_dial")
     assert f.severity == "medium"
     assert "injectable-clock" in f.message
     assert f.key.endswith(":retry-sleep")

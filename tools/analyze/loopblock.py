@@ -77,17 +77,21 @@ DEFAULT_ATTR_LEAVES: dict[str, tuple[str, str]] = {
 # event loop; the analyzer package itself would self-flag its fixtures)
 DEFAULT_EXCLUDE_PREFIXES = ("drand_tpu.testing",)
 
-# retry-sleep rule (ISSUE 12): module path prefixes where a raw
-# ``asyncio.sleep`` inside a retry/backoff loop is a medium finding —
-# retries there must go through drand_tpu/utils/retry.py, whose sleeps
-# ride the INJECTABLE clock, or FakeClock chaos runs lose determinism
-# (a wall-clock sleep is invisible to the fault scheduler's wake-target
-# stepping). A loop counts as retry/backoff when its body both handles
-# an exception (``try/except``) and awaits ``asyncio.sleep`` — the
+# retry-sleep rule (ISSUE 12, scope widened by ISSUE 14): module path
+# prefixes where a raw ``asyncio.sleep`` inside a retry/backoff loop is
+# a medium finding — retries there must go through
+# drand_tpu/utils/retry.py, whose sleeps ride the INJECTABLE clock, or
+# FakeClock chaos runs lose determinism (a wall-clock sleep is
+# invisible to the fault scheduler's wake-target stepping). http_server/
+# and relay/ joined the scope when the relay watch loop moved onto the
+# policy — their restart loops are retrying network edges like any
+# other. A loop counts as retry/backoff when its body both handles an
+# exception (``try/except``) and awaits ``asyncio.sleep`` — the
 # signature of a hand-rolled retry; ``asyncio.sleep(0)`` is a
 # cooperative yield, not a backoff, and stays exempt.
 RETRY_SLEEP_PREFIXES = ("drand_tpu/net/", "drand_tpu/chain/",
-                        "drand_tpu/timelock/")
+                        "drand_tpu/timelock/", "drand_tpu/http_server/",
+                        "drand_tpu/relay/")
 
 _MAX_PATH = 7
 

@@ -97,6 +97,13 @@ KNOWN_LABEL_VALUES = {
                                              "rejected"}},
     "beacon_partial_repairs_total": {"outcome": {"recovered", "synced",
                                                  "failed"}},
+    # edge fan-out set (ISSUE 14): the hub's proto labels are
+    # branch-literal (http_server/fanout.py _wakeup_counter), the shed
+    # reasons literal at both shed sites, the store backend literal in
+    # each backend's read path
+    "relay_wakeups_total": {"proto": {"sse", "ndjson"}},
+    "relay_shed_total": {"reason": {"watcher_cap", "slow_consumer"}},
+    "chain_store_reads_total": {"backend": {"sqlite", "segment"}},
 }
 
 

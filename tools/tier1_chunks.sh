@@ -49,6 +49,15 @@
 #                          no shared mutable state, no clocks) — they
 #                          coexist in one chunk fine; no pair entry
 #                          needed.
+#   test_zz_fanout.py      edge fan-out push tier: SSE/NDJSON hub,
+#                          shedding, segment store, SO_REUSEPORT
+#                          worker smoke (host-only, no pairings except
+#                          the worker smoke's ~15 real signatures;
+#                          ~15 s wall). CONFLICTS check vs
+#                          test_daemon/test_mock_and_scale: the worker
+#                          smoke spawns 3 short-lived relay processes
+#                          on the wall clock but runs no DKG and no
+#                          reshare timers — no contention pair needed.
 #   test_zz_flight.py      threshold flight recorder suite (host-only)
 #   test_zz_obs_health.py  chain-health SLO / OTLP export suite
 #   test_zz_selfheal.py    self-healing plane: retry policy, breakers,
