@@ -45,7 +45,8 @@ class ChainStore(CallbackStore):
     def __init__(self, logger: KVLogger, conf, client: ProtocolClient,
                  crypto: CryptoStore, store: Store, ticker: Ticker):
         base = DiscrepancyStore(AppendStore(store), conf.group, conf.clock,
-                                health=getattr(conf, "health", None))
+                                health=getattr(conf, "health", None),
+                                incidents=getattr(conf, "incidents", None))
         super().__init__(base)
         self._l = logger
         self._conf = conf

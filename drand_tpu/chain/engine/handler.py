@@ -73,6 +73,11 @@ class BeaconConfig:
     # minority-partition node's lag/missed view honest (the singleton's
     # head is a monotonic max across every in-process node)
     health: object | None = None
+    # incident-manager override (obs/incident.IncidentManager), same
+    # per-node rule: None = the per-process INCIDENTS singleton — the
+    # chaos harness injects one per probe node so a minority-partition
+    # node's detections read ITS OWN samples
+    incidents: object | None = None
     # quorum repair (ISSUE 12): active pull of missing partials when
     # the live round is still below threshold past the margin trigger.
     # Off switches the whole monitor (chaos A/B runs, bench baselines).

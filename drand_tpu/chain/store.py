@@ -338,7 +338,8 @@ class DiscrepancyStore(WrappedStore):
     — and hand the completed round's timeline to the OTLP exporter
     (obs/export, flushed off the hot path)."""
 
-    def __init__(self, inner: Store, group, clock, health=None):
+    def __init__(self, inner: Store, group, clock, health=None,
+                 incidents=None):
         super().__init__(inner)
         self._group = group
         self._clock = clock
@@ -348,6 +349,9 @@ class DiscrepancyStore(WrappedStore):
         # head makes a minority-partition node's observations read the
         # majority's progress
         self._health = health
+        # incident-manager override, same per-node rule (obs/incident):
+        # None = the per-process INCIDENTS singleton
+        self._incidents = incidents
 
     def put(self, b: Beacon) -> None:
         self._inner.put(b)
@@ -355,6 +359,7 @@ class DiscrepancyStore(WrappedStore):
             return
         from .. import metrics
         from ..obs import export as obs_export
+        from ..obs import incident as obs_incident
         from ..obs.health import HEALTH
         from ..timelock import service as timelock_service
         from . import time_math
@@ -371,6 +376,12 @@ class DiscrepancyStore(WrappedStore):
                              self._group.genesis_time, b.round)
         obs_export.note_round_complete(b.round,
                                        self._group.get_genesis_seed())
+        # round-boundary hook for the incident engine (obs/incident):
+        # one SLI time-series sample + rule evaluation per stored round
+        # — failures log once and never take the store path down
+        obs_incident.note_round_stored(b.round, now=now,
+                                       period=self._group.period,
+                                       incidents=self._incidents)
         # round-boundary hook for the timelock vault (drand_tpu/timelock):
         # a registered service opens the round's pending ciphertexts in
         # one batched dispatch — a no-op when no vault is serving

@@ -18,6 +18,8 @@ through its localhost control port (cmd/drand-cli/control.go), exactly like
     python -m drand_tpu.cli util trace --merge http://a:port http://b:port
     python -m drand_tpu.cli util engine --url http://host:port
     python -m drand_tpu.cli util flight --url http://host:port [--dkg]
+    python -m drand_tpu.cli util incidents --url http://host:port [--show ID] [--bundle ID -o FILE]
+    python -m drand_tpu.cli util support-bundle --url http://host:port -o FILE
     python -m drand_tpu.cli stop --control PORT
 """
 
@@ -162,6 +164,12 @@ async def _serve_public(d, listen: str, logger, folder: str,
         return await d.client.peer_metrics(addr)
 
     client = DirectClient(d.beacon)
+    # incident forensics persist next to the chain db by default
+    # (ISSUE 15): bundles + the SLI time-series spool survive restarts;
+    # DRAND_TPU_INCIDENT_DIR overrides the location
+    from ..obs.incident import configure_from_env as _incidents_env
+
+    _incidents_env(os.path.join(folder, "db", "incidents"))
     tl_service = None
     if timelock:
         # the timelock vault rides the public API by default: pending
@@ -468,6 +476,72 @@ def _print_flight_dkg(data: dict) -> None:
         print()
 
 
+def _print_incidents(data: dict) -> None:
+    """Render /debug/incidents: one line per incident, newest first."""
+    incs = data.get("incidents", [])
+    if not incs:
+        print("no incidents recorded "
+              f"({data.get('samples', 0)} samples ringed, 0 rules fired)")
+        return
+    print(f"{len(incs)} incident(s), {data.get('active', 0)} open, "
+          f"{data.get('samples', 0)} samples ringed")
+    print(f"{'id':<28} {'severity':<9} {'state':<7} {'round':>8}  detail")
+    for inc in incs:
+        rnd = inc.get("round")
+        print(f"{inc.get('id', '?'):<28} {inc.get('severity', '?'):<9} "
+              f"{inc.get('state', '?'):<7} "
+              f"{rnd if rnd is not None else '-':>8}  "
+              f"{inc.get('detail', '')}")
+
+
+def _print_incident_bundle(bundle: dict) -> None:
+    """Render one incident's forensic bundle (headline + evidence
+    inventory — `--json`/`-o` carry the full payload)."""
+    print(f"incident {bundle.get('id')}  rule={bundle.get('rule')}  "
+          f"severity={bundle.get('severity')}  "
+          f"state={bundle.get('state')}")
+    print(f"  opened_at={bundle.get('opened_at')}  "
+          f"round={bundle.get('round')}  fired={bundle.get('fired')}  "
+          f"closed_at={bundle.get('closed_at')}")
+    print(f"  detail: {bundle.get('detail')}")
+    sus = bundle.get("suspect_peers") or {}
+    print(f"  suspect peers (frozen bitmap round {sus.get('round')}): "
+          f"missing={sus.get('missing')} invalid={sus.get('invalid')} "
+          f"late={sus.get('late')} unreachable={sus.get('unreachable')}")
+    health = bundle.get("health") or {}
+    print(f"  health: head={health.get('head_round')} "
+          f"lag={health.get('lag_rounds')} "
+          f"missed={health.get('missed_total')} "
+          f"sync_stalled={health.get('sync_stalled')}")
+    flight = bundle.get("flight") or {}
+    for rec in (flight.get("rounds") or [])[:8]:
+        margin = rec.get("margin_s")
+        print(f"    round {rec.get('round'):>8}  "
+              f"[{rec.get('bitmap') or '?'}]  "
+              f"margin={margin if margin is not None else '-'}")
+    print(f"  evidence: {len(bundle.get('timeseries') or [])} ts "
+          f"samples, {len(flight.get('rounds') or [])} flight rounds, "
+          f"{len(bundle.get('trace') or [])} round traces, "
+          f"{len(bundle.get('dkg') or [])} dkg sessions, "
+          f"{len(bundle.get('fallback_ledger') or [])} fallback entries, "
+          f"config {((bundle.get('config') or {}).get('fingerprint'))}")
+
+
+def _write_or_print(doc: dict, out: str | None, as_json: bool,
+                    pretty) -> None:
+    """-o FILE writes the JSON payload; otherwise print (pretty or
+    --json)."""
+    if out:
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2)
+        print(json.dumps({"written": out,
+                          "bytes": os.path.getsize(out)}))
+    elif as_json:
+        print(json.dumps(doc, indent=2))
+    else:
+        pretty(doc)
+
+
 def _print_engine_state(data: dict) -> None:
     print(f"dispatch mode: {data.get('mode')}  "
           f"min_batch={data.get('min_batch')}  "
@@ -555,6 +629,45 @@ def cmd_util(args) -> None:
                     _print_flight_matrix(data)
 
         asyncio.run(run_flight())
+        return
+    if args.what == "incidents":
+        # incident engine (ISSUE 15): list summaries, show one bundle,
+        # or save a bundle's JSON to a file for a post-mortem hand-off
+        if not args.url:
+            raise SystemExit("util incidents requires --url "
+                             "http://host:port")
+
+        async def run_incidents():
+            target = args.show or args.bundle
+            if target:
+                data = await _fetch_json(args.url,
+                                         f"/debug/incidents/{target}")
+                _write_or_print(data, args.out, args.json,
+                                _print_incident_bundle)
+            else:
+                data = await _fetch_json(args.url, "/debug/incidents",
+                                         n=args.n)
+                _write_or_print(data, args.out, args.json,
+                                _print_incidents)
+
+        asyncio.run(run_incidents())
+        return
+    if args.what == "support-bundle":
+        # one-shot manual forensic capture (ISSUE 15): the node runs
+        # the incident bundle writer on demand — no anomaly required
+        if not args.url:
+            raise SystemExit("util support-bundle requires --url "
+                             "http://host:port")
+        if not args.out and not args.json:
+            raise SystemExit("util support-bundle requires -o FILE "
+                             "(or --json to print)")
+
+        async def run_support():
+            data = await _fetch_json(args.url, "/debug/support-bundle")
+            _write_or_print(data, args.out, args.json,
+                            _print_incident_bundle)
+
+        asyncio.run(run_support())
         return
     if args.what == "engine":
         # engine introspection of a running node (/debug/engine):
@@ -763,6 +876,10 @@ def cmd_relay(args) -> None:
 
         sources = [HTTPClient(u) for u in args.url.split(",")]
         client = new_client(sources, **_client_trust(args))
+        # relays opt into incident persistence via env only (no folder)
+        from ..obs.incident import configure_from_env as _incidents_env
+
+        _incidents_env(None)
         tl_service = None
         if args.timelock_db:
             # a relay can front the timelock vault too: it opens rounds
@@ -1228,7 +1345,8 @@ def main(argv=None) -> None:
     u = sub.add_parser("util")
     u.add_argument("what", choices=["ping", "check", "del-beacon",
                                     "self-sign", "reset", "trace",
-                                    "engine", "flight", "store-migrate"])
+                                    "engine", "flight", "store-migrate",
+                                    "incidents", "support-bundle"])
     u.add_argument("--control", type=int, default=8888)
     u.add_argument("--address")
     u.add_argument("--folder")
@@ -1241,17 +1359,25 @@ def main(argv=None) -> None:
                         "interleave spans sharing a trace id into one "
                         "cross-node timeline")
     u.add_argument("--n", type=int, default=8,
-                   help="round timelines/flight records to fetch "
-                        "(trace/flight)")
+                   help="round timelines/flight records/incident "
+                        "summaries to fetch (trace/flight/incidents)")
     u.add_argument("--dkg", action="store_true",
                    help="flight: show the DKG phase timeline instead "
                         "of the round matrix")
+    u.add_argument("--show", metavar="ID", default="",
+                   help="incidents: pretty-print one incident's "
+                        "forensic bundle")
+    u.add_argument("--bundle", metavar="ID", default="",
+                   help="incidents: fetch one incident's bundle "
+                        "(pair with -o FILE to save the JSON)")
     u.add_argument("--db", default="",
                    help="store-migrate: SQLite chain db path "
                         "(default <folder>/db/chain.db)")
-    u.add_argument("--out", default="",
+    u.add_argument("-o", "--out", default="",
                    help="store-migrate: segment store directory "
-                        "(default <db dir>/segments)")
+                        "(default <db dir>/segments); "
+                        "incidents/support-bundle: write the bundle "
+                        "JSON to this file")
     u.add_argument("--reverse", action="store_true",
                    help="store-migrate: convert segment->sqlite "
                         "instead of sqlite->segment")

@@ -23,7 +23,6 @@ import cProfile
 import gc
 import io
 import pstats
-import re
 import sys
 import tempfile
 import traceback
@@ -42,13 +41,16 @@ def add_debug_routes(app: web.Application) -> None:
 
 def add_trace_routes(app: web.Application) -> None:
     """The always-on introspection surface: round timelines, engine
-    state and the threshold flight recorder (all dict reads — no
-    profiling cost to gate)."""
+    state, the threshold flight recorder and the incident engine (all
+    dict reads — no profiling cost to gate)."""
     app.add_routes([
         web.get("/debug/trace/rounds", _trace_rounds),
         web.get("/debug/engine", _engine_state),
         web.get("/debug/flight/rounds", _flight_rounds),
         web.get("/debug/flight/dkg", _flight_dkg),
+        web.get("/debug/incidents", _incidents),
+        web.get("/debug/incidents/{id}", _incident_bundle),
+        web.get("/debug/support-bundle", _support_bundle),
     ])
 
 
@@ -56,34 +58,69 @@ async def _trace_rounds(request: web.Request) -> web.Response:
     """The last n completed round timelines from the in-process tracer
     ring — `drand util trace` pretty-prints this payload.
 
-    ``n`` is untrusted public input: only plain base-10 integers parse
-    (no floats, no '1e6', no '0x' — int() would take surprising forms
-    via whitespace/unicode digits), and the value clamps to
-    [1, ring size] so negative/zero/huge asks cannot error or
-    over-allocate."""
+    ``n`` is untrusted public input, validated by the shared
+    ``obs.query.ring_n`` helper (plain base-10 only, clamped to
+    [1, ring size]; anything else 400s)."""
+    from ..obs.query import ring_n
     from ..obs.trace import TRACER
 
-    raw = request.query.get("n", "8").strip()
-    if not re.fullmatch(r"[+-]?[0-9]+", raw):
+    n = ring_n(request.query.get("n"), default=8, cap=TRACER.max_rounds)
+    if n is None:
         return web.json_response({"error": "bad n"}, status=400)
-    n = max(1, min(int(raw), TRACER.max_rounds))
     return web.json_response({"rounds": TRACER.rounds(n)})
 
 
 async def _flight_rounds(request: web.Request) -> web.Response:
     """The flight recorder's per-round partial-arrival records
     (`drand util flight` renders the rounds × nodes matrix from this).
-    ``n`` validates exactly like /debug/trace/rounds — plain base-10
-    only, clamped to [1, ring size]."""
+    ``n`` validates via the shared obs.query.ring_n helper."""
     from ..obs.flight import FLIGHT
+    from ..obs.query import ring_n
 
-    raw = request.query.get("n", "16").strip()
-    if not re.fullmatch(r"[+-]?[0-9]+", raw):
+    n = ring_n(request.query.get("n"), default=16, cap=FLIGHT.max_rounds)
+    if n is None:
         return web.json_response({"error": "bad n"}, status=400)
-    n = max(1, min(int(raw), FLIGHT.max_rounds))
     return web.json_response({"rounds": FLIGHT.rounds(n),
                               "peers": FLIGHT.peers(),
                               "reach": FLIGHT.reachability()})
+
+
+async def _incidents(request: web.Request) -> web.Response:
+    """The incident engine's summaries, most recent first (ISSUE 15):
+    what fired, when, at what severity, open/closed. ``n`` validates
+    via the shared obs.query.ring_n helper like the other ring
+    routes."""
+    from ..obs.incident import INCIDENTS
+    from ..obs.query import ring_n
+
+    n = ring_n(request.query.get("n"), default=32,
+               cap=INCIDENTS.max_incidents)
+    if n is None:
+        return web.json_response({"error": "bad n"}, status=400)
+    return web.json_response({"incidents": INCIDENTS.incidents(n),
+                              "active": INCIDENTS.active_count(),
+                              "samples": len(INCIDENTS.ring)})
+
+
+async def _incident_bundle(request: web.Request) -> web.Response:
+    """One incident's full forensic bundle — the frozen evidence
+    (`drand-tpu util incidents --bundle ID -o FILE` fetches this)."""
+    from ..obs.incident import INCIDENTS
+
+    bundle = INCIDENTS.get_bundle(request.match_info["id"])
+    if bundle is None:
+        return web.json_response({"error": "unknown incident id"},
+                                 status=404)
+    return web.json_response(bundle)
+
+
+async def _support_bundle(request: web.Request) -> web.Response:
+    """One-shot manual forensic capture — the incident bundle writer
+    run on demand (`drand-tpu util support-bundle -o FILE`). Mints no
+    incident; just freezes the current evidence."""
+    from ..obs.incident import INCIDENTS
+
+    return web.json_response(INCIDENTS.capture_bundle())
 
 
 async def _flight_dkg(request: web.Request) -> web.Response:

@@ -511,6 +511,12 @@ class PublicServer:
         head = await self._head_round()
         HEALTH.observe_chain(self._clock.now(), info.period,
                              info.genesis_time, head)
+        # on-demand incident sample (ISSUE 15, same pull model): a
+        # fully stalled chain stores nothing, so probes must drive the
+        # missed-round/readiness detectors too (rate-limited inside)
+        from ..obs.incident import INCIDENTS
+
+        INCIDENTS.poll(self._clock.now(), info.period)
         snap = HEALTH.snapshot()
         snap["period"] = info.period
         return snap, info

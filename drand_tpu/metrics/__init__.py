@@ -118,6 +118,17 @@ active-recovery tier the ISSUE-11 fault oracle proved was missing):
   relay_stale_served_total             [http]    /public/latest
       responses served from the last-known beacon with the
       X-Drand-Stale header because the upstream was unreachable
+Incident engine (obs/incident.py + obs/timeseries.py, ISSUE 15 — the
+anomaly rules evaluated on every SLI time-series sample, minting
+incidents with frozen forensic bundles):
+  incidents_total{rule,severity}       [group]   incidents minted by
+      the detector, by rule (missed_round | readiness_flip |
+      breaker_open | reachability_drop | sync_stall |
+      margin_degraded | ingress_flood | shed_surge | custom) and
+      severity (critical | major | warning) — one per SUSTAINED fault
+      (re-fires extend the open incident, cooldown suppresses flaps)
+  incident_active                      [group]   currently open
+      incidents (their rules still firing or not yet cleared)
 Edge fan-out set (http_server/fanout.py hub + chain/segments.py,
 ISSUE 14 — the push tier on /public/latest and the packed segment
 chain store behind it):
@@ -402,6 +413,19 @@ PARTIAL_REPAIRS = Counter(
     "had already stored the round, the beacon is fetched via sync "
     "instead; failed = the round stayed below threshold)",
     ["outcome"], registry=GROUP_REGISTRY)
+# ---- incident engine (obs/incident.py, ISSUE 15) --------------------------
+INCIDENTS_TOTAL = Counter(
+    "incidents_total",
+    "Incidents minted by the anomaly detector over the SLI time-series "
+    "ring, by rule and severity — one per sustained fault (re-fires "
+    "extend the open incident; the per-rule cooldown suppresses flaps)",
+    ["rule", "severity"], registry=GROUP_REGISTRY)
+INCIDENT_ACTIVE = Gauge(
+    "incident_active",
+    "Currently open incidents: their rules are still firing or have "
+    "not yet stayed quiet for the clear window",
+    registry=GROUP_REGISTRY)
+
 RELAY_STALE_SERVED = Counter(
     "relay_stale_served_total",
     "/public/latest responses served from the last-known beacon with "

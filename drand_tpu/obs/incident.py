@@ -1,0 +1,696 @@
+"""Incident engine: anomaly rules over the SLI time-series ring, with
+auto-captured forensic bundles (ISSUE 15).
+
+The chaos oracle (PRs 11-12) proved which SLIs predict and explain
+faults — but a human had to be watching. This module turns each of
+those proven signals into a **detector**: declarative rules evaluated
+on every time-series sample (obs/timeseries.py), minting an *incident*
+when they fire and freezing a **forensic bundle** — the evidence an
+operator needs for a post-mortem, captured at the moment it still
+exists in the live rings:
+
+- the affected rounds' trace timelines (obs/trace ring),
+- the flight-ring slice with contribution bitmaps (+ the derived
+  ``suspect_peers`` set: who was missing/invalid/unreachable),
+- the DKG timeline when a ceremony is live,
+- the health snapshot, per-peer breaker states, the engine fallback
+  ledger,
+- the time-series window itself, and a config fingerprint.
+
+**Rules** come in two shapes. *Edge* rules fire on a counter increment
+or a state flip (missed-round increment, breaker OPEN, readiness
+flip, sync stall). *Trend* rules fire on windows (quorum margin below
+the warn fraction / sloping toward negative, ingress-reject floods,
+watcher-shed surges, reachability drops). Each rule carries a
+severity, a cooldown and dedup semantics: while a rule keeps firing
+the SAME incident stays open (``fired`` counts re-triggers), it closes
+after ``clear_after`` quiet samples, and the cooldown then suppresses
+an immediate re-mint — one sustained fault mints exactly ONE incident,
+not hundreds. The margin rule's warn fraction matches the PR-11
+oracle's, so its detection lead is the oracle's by construction: it
+fires rounds BEFORE ``beacon_rounds_missed_total`` moves.
+
+**Retention**: incidents live in a bounded in-memory ring and — when an
+incident directory is configured (the daemon defaults to
+``<folder>/db/incidents``; ``DRAND_TPU_INCIDENT_DIR`` overrides) — as
+one rotated JSON bundle file each, oldest deleted past
+``DRAND_TPU_INCIDENT_MAX`` (32). Bundles are secret-hygiene-clean BY
+CONSTRUCTION: every field is read off surfaces that already enforce
+the no-secrets rule (flight/trace/health/metrics), and the config
+fingerprint redacts any secret-named env var. tools/analyze secretflow
+registers the bundle writers as sinks, so a future change routing key
+material into a bundle fails the static gate like logging it would.
+
+Surfaces: ``GET /debug/incidents`` / ``/debug/incidents/{id}`` on the
+always-on debug plane, ``drand-tpu util incidents`` /
+``util support-bundle`` (the manual capture reuses
+:meth:`IncidentManager.capture_bundle` verbatim), and the catalogued
+``incidents_total{rule,severity}`` / ``incident_active`` metrics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import logging
+import os
+import re
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable
+
+from .timeseries import TimeSeriesRing, collect_sample
+
+# the PR-11 oracle's warn fraction (testing/chaos.detection_lead):
+# margin below this fraction of the period is the early warning — the
+# incident rule fires exactly where the oracle's warn_round lands
+MARGIN_WARN_FRACTION = 0.5
+# trend-rule thresholds (per-sample deltas)
+FLOOD_MIN = int(os.environ.get("DRAND_TPU_INCIDENT_FLOOD_MIN", "16"))
+SHED_MIN = int(os.environ.get("DRAND_TPU_INCIDENT_SHED_MIN", "8"))
+
+# env-var names matching this are value-redacted in config fingerprints
+_SECRETISH_ENV = re.compile(r"(?i)(secret|_key|token|passw|share|seed)")
+
+_log = logging.getLogger("drand_tpu.obs.incident")
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Rule:
+    """One declarative detector over the time-series window.
+
+    ``trigger`` takes (window, ctx) — samples oldest-first and
+    ``{"period": float | None, "open": bool}`` (``open`` = this rule
+    already has an open incident, for latching triggers) — and returns
+    a human detail string while the anomaly holds, else None.
+    ``clear_after`` quiet samples close the incident; ``cooldown_s``
+    then suppresses a re-mint."""
+
+    name: str
+    severity: str              # critical | major | warning
+    kind: str                  # edge | trend
+    trigger: Callable[[list[dict], dict], str | None] = field(repr=False)
+    cooldown_s: float = 30.0
+    clear_after: int = 2
+
+
+def _t_missed(w: list[dict], ctx: dict) -> str | None:
+    d = w[-1]["deltas"].get("missed_total", 0)
+    if d > 0:
+        return (f"{int(d)} round(s) missed this sample "
+                f"(total {int(w[-1]['missed_total'])})")
+    return None
+
+
+def _t_margin(w: list[dict], ctx: dict) -> str | None:
+    period = ctx.get("period") or w[-1].get("period")
+    m = w[-1].get("margin_s")
+    if not period or m is None:
+        return None
+    if m < MARGIN_WARN_FRACTION * period:
+        return (f"quorum margin {m:.3f}s below "
+                f"{MARGIN_WARN_FRACTION:.0%} of the {period}s period")
+    # slope: the last 3 distinct-round margins strictly decreasing and
+    # extrapolating to ≤0 within two more rounds — degradation heading
+    # for a miss even while still above the warn fraction
+    margins: list[float] = []
+    seen_rounds: set = set()
+    for s in reversed(w):
+        sm, fr = s.get("margin_s"), s.get("flight_round")
+        if sm is None or fr in seen_rounds:
+            continue
+        seen_rounds.add(fr)
+        margins.append(sm)
+        if len(margins) == 3:
+            break
+    if len(margins) == 3 and margins[0] < margins[1] < margins[2]:
+        slope = margins[1] - margins[0]  # per-round loss (newest first)
+        if margins[0] - 2 * slope <= 0:
+            return (f"quorum margin sloping to a miss: "
+                    f"{margins[2]:.3f} -> {margins[1]:.3f} -> "
+                    f"{margins[0]:.3f}s over the last 3 rounds")
+    return None
+
+
+def _t_breaker(w: list[dict], ctx: dict) -> str | None:
+    n = w[-1].get("breakers_open", 0)
+    if n > 0:
+        return f"{int(n)} peer circuit breaker(s) OPEN"
+    return None
+
+
+def _t_reach(w: list[dict], ctx: dict) -> str | None:
+    n = w[-1].get("suspects", 0)
+    if n > 0:
+        return f"{int(n)} peer(s) unreachable (partition suspects)"
+    return None
+
+
+def _t_ready(w: list[dict], ctx: dict) -> str | None:
+    if w[-1].get("ready"):
+        return None
+    # LATCHED while the incident is open: the flip's "was ready"
+    # baseline ages out of the sample window during a long outage, and
+    # the incident must not self-close while /readyz is still failing
+    if ctx.get("open"):
+        return (f"readiness still down: head lag {w[-1]['lag']} rounds "
+                f"(failing /readyz)")
+    # spool-restored samples never count as the "was ready" baseline: a
+    # routine restart that needs catch-up is not a live readiness flip
+    if any(s.get("ready") and not s.get("restored") for s in w[:-1]):
+        return (f"readiness flipped: head lag {w[-1]['lag']} rounds "
+                f"(was serving, now failing /readyz)")
+    return None
+
+
+def _t_stall(w: list[dict], ctx: dict) -> str | None:
+    if w[-1].get("sync_stalled"):
+        return (f"chain sync stalled at lag {w[-1]['lag']} rounds "
+                f"with no catch-up progressing")
+    return None
+
+
+def _t_flood(w: list[dict], ctx: dict) -> str | None:
+    d = w[-1]["deltas"].get("ingress_rejects", 0)
+    if d >= FLOOD_MIN:
+        return f"{int(d)} ingress rejects in one sample (flood)"
+    return None
+
+
+def _t_shed(w: list[dict], ctx: dict) -> str | None:
+    d = w[-1]["deltas"].get("watcher_shed", 0)
+    if d >= SHED_MIN:
+        return f"{int(d)} watchers shed in one sample (overload)"
+    return None
+
+
+def default_rules() -> list[Rule]:
+    """The built-in detector set — one rule per chaos-proven SLI
+    (README "Incident forensics" documents each with its fault)."""
+    return [
+        Rule("missed_round", "critical", "edge", _t_missed),
+        Rule("readiness_flip", "critical", "edge", _t_ready),
+        Rule("breaker_open", "major", "edge", _t_breaker),
+        Rule("reachability_drop", "major", "trend", _t_reach),
+        Rule("sync_stall", "major", "edge", _t_stall),
+        Rule("margin_degraded", "warning", "trend", _t_margin),
+        Rule("ingress_flood", "warning", "trend", _t_flood),
+        Rule("shed_surge", "warning", "trend", _t_shed),
+    ]
+
+
+def _incident_counter(rule: str):
+    """Branch-literal rule+severity labels for incidents_total (the
+    check_metrics KNOWN_LABEL_VALUES enum rule — same pattern as
+    obs/flight's label helpers). Each built-in rule carries its
+    canonical severity; unknown (operator-supplied) rules collapse to
+    ``custom`` rather than forking the series."""
+    from .. import metrics
+
+    if rule == "missed_round":
+        return metrics.INCIDENTS_TOTAL.labels(rule="missed_round",
+                                              severity="critical")
+    if rule == "readiness_flip":
+        return metrics.INCIDENTS_TOTAL.labels(rule="readiness_flip",
+                                              severity="critical")
+    if rule == "breaker_open":
+        return metrics.INCIDENTS_TOTAL.labels(rule="breaker_open",
+                                              severity="major")
+    if rule == "reachability_drop":
+        return metrics.INCIDENTS_TOTAL.labels(rule="reachability_drop",
+                                              severity="major")
+    if rule == "sync_stall":
+        return metrics.INCIDENTS_TOTAL.labels(rule="sync_stall",
+                                              severity="major")
+    if rule == "margin_degraded":
+        return metrics.INCIDENTS_TOTAL.labels(rule="margin_degraded",
+                                              severity="warning")
+    if rule == "ingress_flood":
+        return metrics.INCIDENTS_TOTAL.labels(rule="ingress_flood",
+                                              severity="warning")
+    if rule == "shed_surge":
+        return metrics.INCIDENTS_TOTAL.labels(rule="shed_surge",
+                                              severity="warning")
+    return metrics.INCIDENTS_TOTAL.labels(rule="custom",
+                                          severity="warning")
+
+
+# ---------------------------------------------------------------------------
+# forensics
+# ---------------------------------------------------------------------------
+
+def config_fingerprint() -> dict:
+    """The node's operational knobs (DRAND_TPU_*) plus a stable digest
+    — enough to answer "was this node configured like the others?"
+    without shipping the whole environment. Secret-named values are
+    redacted by construction (defense in depth: no current knob holds
+    key material, and a future one that does must not leak here)."""
+    env = {}
+    for k in sorted(os.environ):
+        if not k.startswith("DRAND_TPU_"):
+            continue
+        env[k] = "<redacted>" if _SECRETISH_ENV.search(k) \
+            else os.environ[k]
+    digest = hashlib.blake2b(
+        json.dumps(env, sort_keys=True).encode(), digest_size=8).hexdigest()
+    return {"fingerprint": digest, "env": env}
+
+
+def suspect_peers(flight) -> dict:
+    """The faulted peer set, named from the FROZEN evidence: the
+    newest flight round's contribution bitmap (missing / invalid /
+    late share indices) plus the reachability view (unreachable)."""
+    from .flight import BITMAP_INVALID, BITMAP_LATE, BITMAP_MISSING
+
+    out: dict = {"round": None, "missing": [], "invalid": [],
+                 "late": [], "unreachable": []}
+    for rec in flight.rounds(1):
+        out["round"] = rec.get("round")
+        for idx, ch in enumerate(rec.get("bitmap") or ""):
+            if ch == BITMAP_MISSING:
+                out["missing"].append(idx)
+            elif ch == BITMAP_INVALID:
+                out["invalid"].append(idx)
+            elif ch == BITMAP_LATE:
+                out["late"].append(idx)
+    out["unreachable"] = sorted(
+        int(i) for i, up in flight.reachability().items() if not up)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the manager
+# ---------------------------------------------------------------------------
+
+class IncidentManager:
+    """Sampling + rule evaluation + incident lifecycle + bundles.
+
+    Per-process singleton (``INCIDENTS``) by default, reading the
+    FLIGHT/HEALTH singletons; in-process multi-node harnesses build one
+    per probe node with that node's recorders injected (the
+    BeaconConfig.flight/.health pattern). Thread-safe: sampling is
+    driven both from the store path (to_thread aggregation workers) and
+    from /healthz probes on the loop — every mutation is under one
+    lock, no awaits or pairing-class work inside it."""
+
+    def __init__(self, *, flight=None, health=None,
+                 rules: list[Rule] | None = None,
+                 ring: TimeSeriesRing | None = None,
+                 dir_path: str | None = None,
+                 max_incidents: int = 32,
+                 ts_window: int = 64,
+                 bundle_rounds: int = 16,
+                 poll_min_interval: float = 1.0):
+        self._flight = flight
+        self._health = health
+        self.rules = list(rules) if rules is not None else default_rules()
+        self.ring = ring if ring is not None else TimeSeriesRing()
+        self.dir_path = dir_path
+        self.max_incidents = max_incidents
+        self.ts_window = ts_window
+        self.bundle_rounds = bundle_rounds
+        self.poll_min_interval = poll_min_interval
+        self._lock = threading.Lock()
+        # id -> {"summary": dict, "bundle": dict | None (on disk only)}
+        self._incidents: OrderedDict[str, dict] = OrderedDict()
+        self._active: dict[str, dict] = {}      # rule name -> summary
+        self._quiet: dict[str, int] = {}        # rule name -> quiet samples
+        self._cooldown_until: dict[str, float] = {}
+        self._seq = 0
+        self._period: float | None = None
+        self._last_sample_t = float("-inf")
+        self._persist_warned = False
+        self._sample_warned = False
+
+    # ------------------------------------------------------------ plumbing
+    def _flight_obj(self):
+        if self._flight is not None:
+            return self._flight
+        from .flight import FLIGHT
+
+        return FLIGHT
+
+    def _health_obj(self):
+        if self._health is not None:
+            return self._health
+        from .health import HEALTH
+
+        return HEALTH
+
+    def configure(self, *, dir_path: str | None = None,
+                  spool_path: str | None = None,
+                  max_incidents: int | None = None) -> None:
+        """(Re)configure persistence: incident directory, time-series
+        spool, retention bound. Loads what already exists — incident
+        summaries from the directory, ring history from the spool — so
+        forensics survive a restart."""
+        with self._lock:
+            if max_incidents is not None:
+                self.max_incidents = max_incidents
+            if dir_path is not None:
+                self.dir_path = dir_path
+                self._load_dir_locked()
+        if spool_path is not None and self.ring.spool_path != spool_path:
+            self.ring.set_spool(spool_path)
+            self.ring.load_spool()
+
+    def _load_dir_locked(self) -> None:
+        if not self.dir_path or not os.path.isdir(self.dir_path):
+            return
+        names = sorted(n for n in os.listdir(self.dir_path)
+                       if n.startswith("inc-") and n.endswith(".json"))
+        for name in names[-self.max_incidents:]:
+            inc_id = name[:-len(".json")]
+            if inc_id in self._incidents:
+                continue
+            try:
+                with open(os.path.join(self.dir_path, name),
+                          encoding="utf-8") as f:
+                    bundle = json.load(f)
+            except (OSError, ValueError):
+                continue  # a torn write must not wedge boot
+            summary = {k: bundle.get(k) for k in
+                       ("id", "rule", "severity", "detail", "opened_at",
+                        "round", "state", "closed_at", "fired",
+                        "last_seen")}
+            # an incident that was open when the process died never got
+            # its close sample — it must not read as live forever (the
+            # rule re-mints if the fault persists across the restart)
+            summary["state"] = "stale" \
+                if summary.get("state") == "open" \
+                else (summary.get("state") or "closed")
+            self._incidents[inc_id] = {"summary": summary, "bundle": None}
+            try:
+                seq = int(inc_id.split("-")[1])
+                self._seq = max(self._seq, seq)
+            except (IndexError, ValueError):
+                pass
+        while len(self._incidents) > self.max_incidents:
+            self._incidents.popitem(last=False)
+
+    # ------------------------------------------------------------ sampling
+    def on_round(self, round_no: int | None, *, now: float,
+                 period: float) -> dict:
+        """The round-boundary sample: called by the store hook for
+        every stored beacon (and by harnesses per advanced round).
+        Samples, evaluates every rule, mints/extends/closes incidents.
+        Returns the annotated sample."""
+        flight, health = self._flight_obj(), self._health_obj()
+        sample = collect_sample(now, flight=flight, health=health,
+                                period=period, round_no=round_no)
+        sample = self.ring.append(sample)
+        with self._lock:
+            self._period = period
+            self._last_sample_t = now
+            dirty = self._evaluate_locked(now, period)
+        if dirty:
+            self._persist_dirty(dirty)
+        return sample
+
+    def _persist_dirty(self, dirty: list[str]) -> None:
+        """Persist bundles + flush the spool OUTSIDE the manager lock —
+        and, when the caller is ON the event loop (the /healthz poll
+        path), off the loop entirely: a mint serializes a multi-KB
+        bundle and runs several fs syscalls (2-4 ms each on this box's
+        overlay fs), which must not stall every concurrent request.
+        Mints are cooldown-bounded, so the spawned thread count is too.
+        Synchronous callers (store-thread hook, harnesses, tests) get
+        the inline path — file state is deterministic when they
+        return."""
+
+        def work() -> None:
+            for inc_id in dirty:
+                self._persist(inc_id)
+            self.ring.flush()  # forensic moments get spool durability
+
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            work()
+            return
+        threading.Thread(target=work, name="incident-persist",
+                         daemon=True).start()
+
+    def poll(self, now: float, period: float | None = None) -> dict | None:
+        """The on-demand sample (pull model, like HEALTH.observe_chain):
+        /healthz probes drive detection even when NO beacons land — a
+        fully stalled chain still samples, so the missed-round and
+        readiness rules fire without a single store. Rate-limited so a
+        probe storm cannot grow the ring faster than time passes (the
+        slot is RESERVED inside the locked check — a store-thread
+        sample racing a loop-side probe cannot both pass it)."""
+        with self._lock:
+            p = period if period is not None else self._period
+            if p is None:
+                return None
+            if now - self._last_sample_t < self.poll_min_interval:
+                return None
+            self._last_sample_t = now
+        return self.on_round(None, now=now, period=p)
+
+    # ------------------------------------------------------------- rules
+    def _evaluate_locked(self, now: float,
+                         period: float | None) -> list[str]:
+        """Evaluate every rule against the window; mint/extend/close.
+        Returns the incident ids whose disk state is now dirty — the
+        caller persists them OUTSIDE the lock."""
+        from .. import metrics
+
+        window = self.ring.window(self.ring.max_samples)
+        if not window:
+            return []
+        dirty: list[str] = []
+        for rule in self.rules:
+            # ctx carries whether THIS rule already has an open
+            # incident, so a trigger can latch on it (readiness_flip)
+            ctx = {"period": period, "open": rule.name in self._active}
+            try:
+                detail = rule.trigger(window, ctx)
+            except Exception:  # noqa: BLE001 — a broken operator rule
+                detail = None  # must not kill the built-in detectors
+            open_inc = self._active.get(rule.name)
+            if detail is not None:
+                self._quiet[rule.name] = 0
+                if open_inc is not None:
+                    open_inc["fired"] += 1
+                    open_inc["last_seen"] = now
+                    open_inc["detail"] = detail
+                elif now >= self._cooldown_until.get(rule.name,
+                                                     float("-inf")):
+                    dirty.append(
+                        self._mint_locked(rule, detail, now, window[-1]))
+            elif open_inc is not None:
+                q = self._quiet.get(rule.name, 0) + 1
+                self._quiet[rule.name] = q
+                if q >= rule.clear_after:
+                    open_inc["state"] = "closed"
+                    open_inc["closed_at"] = now
+                    dirty.append(open_inc["id"])
+                    del self._active[rule.name]
+                    self._cooldown_until[rule.name] = now + rule.cooldown_s
+        metrics.INCIDENT_ACTIVE.set(len(self._active))
+        return dirty
+
+    def _mint_locked(self, rule: Rule, detail: str, now: float,
+                     sample: dict) -> str:
+        self._seq += 1
+        inc_id = f"inc-{self._seq:05d}-{rule.name}"
+        summary = {"id": inc_id, "rule": rule.name,
+                   "severity": rule.severity, "detail": detail,
+                   "opened_at": round(now, 6),
+                   "round": sample.get("round") or sample.get("head"),
+                   "state": "open", "closed_at": None,
+                   "fired": 1, "last_seen": round(now, 6)}
+        bundle = self._freeze_locked(summary, sample)
+        self._incidents[inc_id] = {"summary": summary, "bundle": bundle}
+        self._active[rule.name] = summary
+        self._quiet[rule.name] = 0
+        # retention: evict oldest CLOSED incidents past the bound. OPEN
+        # ones are never evicted (they'd go inconsistent with _active
+        # and lose their eventual close) — at most len(rules) can be
+        # open, so memory stays bounded at max_incidents + rules.
+        excess = len(self._incidents) - self.max_incidents
+        if excess > 0:
+            for victim_id in [i for i, rec in self._incidents.items()
+                              if rec["summary"]["state"] != "open"][:excess]:
+                del self._incidents[victim_id]
+        _incident_counter(rule.name).inc()
+        return inc_id
+
+    # ------------------------------------------------------------ bundles
+    def _freeze_locked(self, summary: dict, sample: dict | None) -> dict:
+        """Freeze the forensic evidence NOW, while the rings still hold
+        it. Every field reads an existing no-secrets surface; the
+        writer itself is a registered secretflow sink."""
+        from ..crypto import batch
+        from .. import metrics
+        from .timeseries import _gauge_by_label
+        from .trace import TRACER
+
+        flight, health = self._flight_obj(), self._health_obj()
+        bundle = dict(summary)
+        bundle.update({
+            "period": self._period,
+            "sample": sample,
+            "timeseries": self.ring.window(self.ts_window),
+            "suspect_peers": suspect_peers(flight),
+            "flight": {"rounds": flight.rounds(self.bundle_rounds),
+                       "peers": flight.peers(),
+                       "reach": flight.reachability()},
+            "dkg": flight.dkg.sessions(),
+            "trace": TRACER.rounds(min(8, self.bundle_rounds)),
+            "health": health.snapshot(),
+            "breakers": _gauge_by_label(metrics.PEER_BREAKER_STATE,
+                                        "index"),
+            "fallback_ledger": batch.fallback_ledger(),
+            "config": config_fingerprint(),
+        })
+        return bundle
+
+    def _persist(self, inc_id: str) -> None:
+        """Write/refresh the bundle file and rotate the directory down
+        to ``max_incidents`` (oldest first — ids are seq-ordered; files
+        of still-open incidents are never rotated away). Serialization
+        happens under a brief lock; all fs syscalls run OUTSIDE it."""
+        if not self.dir_path:
+            return
+        with self._lock:
+            rec = self._incidents.get(inc_id)
+            if rec is None or rec["bundle"] is None:
+                return  # evicted, or a disk-loaded summary: file is
+                # already in its final state
+            rec["bundle"].update(rec["summary"])  # state/closed refresh
+            payload = json.dumps(rec["bundle"], separators=(",", ":"))
+            keep = {f"{s['id']}.json" for s in self._active.values()}
+            dir_path, bound = self.dir_path, self.max_incidents
+        try:
+            os.makedirs(dir_path, exist_ok=True)
+            path = os.path.join(dir_path, f"{inc_id}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(payload)
+            os.replace(tmp, path)
+            names = sorted(n for n in os.listdir(dir_path)
+                           if n.startswith("inc-") and n.endswith(".json"))
+            excess = len(names) - bound
+            if excess > 0:
+                for name in [n for n in names if n not in keep][:excess]:
+                    os.unlink(os.path.join(dir_path, name))
+        except OSError:
+            with self._lock:
+                warned, self._persist_warned = self._persist_warned, True
+            if not warned:
+                _log.warning("incident bundle write failed for %s "
+                             "(dir %s); forensics stay in memory only",
+                             inc_id, self.dir_path)
+
+    def capture_bundle(self, *, now: float | None = None,
+                       reason: str = "manual") -> dict:
+        """One-shot MANUAL capture — ``drand-tpu util support-bundle``
+        and ``GET /debug/support-bundle``. Reuses the incident bundle
+        writer verbatim (same freeze, same surfaces) but mints no
+        incident and counts nothing: operators get forensics without
+        waiting for an anomaly."""
+        with self._lock:
+            if now is None:
+                last = self.ring.last()
+                now = last["t"] if last else 0.0
+            summary = {"id": f"support-{reason}", "rule": reason,
+                       "severity": "none", "detail": "manual capture",
+                       "opened_at": round(now, 6), "round": None,
+                       "state": "manual", "closed_at": None,
+                       "fired": 0, "last_seen": round(now, 6)}
+            return self._freeze_locked(summary, self.ring.last())
+
+    # ------------------------------------------------------------ outputs
+    def incidents(self, n: int = 32) -> list[dict]:
+        """The last ``n`` incident summaries, most recent first."""
+        with self._lock:
+            recs = list(self._incidents.values())[-n:] if n > 0 else []
+            return [dict(r["summary"]) for r in reversed(recs)]
+
+    def active_count(self) -> int:
+        with self._lock:
+            return len(self._active)
+
+    def get_bundle(self, inc_id: str) -> dict | None:
+        """The full bundle for one incident — memory first, then the
+        on-disk file (summaries loaded at boot keep bundles on disk)."""
+        with self._lock:
+            rec = self._incidents.get(inc_id)
+            if rec is not None and rec["bundle"] is not None:
+                # lifecycle fields (state/closed_at/fired) live on the
+                # summary; refresh the frozen bundle so a memory-only
+                # node (no incident dir — _persist never runs) serves
+                # the same lifecycle the listing shows
+                rec["bundle"].update(rec["summary"])
+                return dict(rec["bundle"])
+            dir_path = self.dir_path
+        if rec is None and not _INC_ID_RE.fullmatch(inc_id):
+            return None  # never let a crafted id walk the filesystem
+        if dir_path:
+            path = os.path.join(dir_path, f"{inc_id}.json")
+            try:
+                with open(path, encoding="utf-8") as f:
+                    return json.load(f)
+            except (OSError, ValueError):
+                return None
+        return None
+
+    def reset(self) -> None:
+        """Back to boot state (tests) — memory only; disk untouched."""
+        with self._lock:
+            self._incidents.clear()
+            self._active.clear()
+            self._quiet.clear()
+            self._cooldown_until.clear()
+            self._seq = 0
+            self._period = None
+            self._last_sample_t = float("-inf")
+            self._persist_warned = False
+            self._sample_warned = False
+        self.ring.reset()
+
+
+# ids are minted as inc-<seq>-<rule>; anything else never touches disk
+_INC_ID_RE = re.compile(r"inc-[0-9]{1,12}-[a-z_]{1,40}")
+
+
+# The per-process manager every hook shares (like TRACER/HEALTH/FLIGHT).
+INCIDENTS = IncidentManager()
+
+
+def configure_from_env(default_dir: str | None = None) -> None:
+    """Wire the singleton's persistence from the environment (the
+    daemon passes ``<folder>/db/incidents`` as the default; relays opt
+    in via ``DRAND_TPU_INCIDENT_DIR``)."""
+    dir_path = os.environ.get("DRAND_TPU_INCIDENT_DIR") or default_dir
+    if not dir_path:
+        return
+    spool = os.environ.get("DRAND_TPU_INCIDENT_SPOOL") \
+        or os.path.join(dir_path, "timeseries.ndjson")
+    INCIDENTS.configure(
+        dir_path=dir_path, spool_path=spool,
+        max_incidents=int(os.environ.get("DRAND_TPU_INCIDENT_MAX", "32")))
+
+
+def note_round_stored(round_no: int, *, now: float, period: float,
+                      incidents: IncidentManager | None = None) -> None:
+    """The DiscrepancyStore hook: sample + evaluate at the round
+    boundary. Telemetry must never take the store path down — failures
+    log once and are dropped."""
+    mgr = incidents if incidents is not None else INCIDENTS
+    try:
+        mgr.on_round(round_no, now=now, period=period)
+    except Exception:  # noqa: BLE001 — forensics must not break stores
+        with mgr._lock:
+            warned, mgr._sample_warned = mgr._sample_warned, True
+        if not warned:
+            _log.warning("incident sampling failed at round %s",
+                         round_no, exc_info=True)

@@ -1,0 +1,38 @@
+"""Shared validation for the debug plane's ``?n=`` ring-window
+parameter (ISSUE 15 satellite).
+
+``/debug/trace/rounds``, ``/debug/flight/rounds`` and
+``/debug/incidents`` each take an untrusted public ``n``; before this
+module each route hand-rolled the identical regex + clamp. The
+semantics are frozen here exactly as the PR-6 hardening defined them:
+
+- only PLAIN base-10 integers parse — no floats, no ``1e6``, no
+  ``0x10``; a bare ``int()`` would also take surprising
+  whitespace/underscore/unicode-digit forms;
+- the value clamps to ``[1, cap]`` (the ring size), so negative, zero
+  or huge asks can neither error nor over-allocate;
+- anything else is invalid → the caller answers 400.
+
+The URL-encoding regression matrix (a literal ``+`` in a query string
+decodes to a space, so explicit-sign probes must be percent-encoded)
+points at this one function now — see tests/test_zz_incident.py and
+the original matrix in tests/test_zz_obs_health.py.
+"""
+
+from __future__ import annotations
+
+import re
+
+_N_RE = re.compile(r"[+-]?[0-9]+")
+
+
+def ring_n(raw: str | None, *, default: int, cap: int) -> int | None:
+    """Parse+clamp a ``?n=`` value. ``raw`` is the query param (None =
+    absent → ``default``); returns the clamped window size, or None
+    when the input is invalid (the caller 400s)."""
+    if raw is None:
+        return max(1, min(default, cap))
+    raw = raw.strip()
+    if not _N_RE.fullmatch(raw):
+        return None
+    return max(1, min(int(raw), cap))

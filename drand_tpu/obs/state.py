@@ -33,15 +33,18 @@ from contextlib import contextmanager
 
 def reset_observability() -> None:
     """Reset FLIGHT (rounds, peers, reachability, DKG timelines),
-    HEALTH and TRACER to boot state. Safe against concurrent note_*
-    calls — each singleton's own reset carries its lock discipline."""
+    HEALTH, TRACER and INCIDENTS (time-series ring + incident state)
+    to boot state. Safe against concurrent note_* calls — each
+    singleton's own reset carries its lock discipline."""
     from .flight import FLIGHT
     from .health import HEALTH
+    from .incident import INCIDENTS
     from .trace import TRACER
 
     FLIGHT.reset()
     HEALTH.reset()
     TRACER.reset()
+    INCIDENTS.reset()
 
 
 @contextmanager

@@ -1,0 +1,258 @@
+"""SLI time-series ring: the metrics catalogue, sampled over time
+(ISSUE 15).
+
+Everything the observability stack exposes so far is live-state only —
+the trace/flight rings evict, the gauges overwrite, and once a fault
+heals the evidence is gone. This module keeps a bounded in-process ring
+of **samples**: one flat dict per round boundary (plus on-demand pulls
+from /healthz probes) holding every SLI the incident rules evaluate —
+quorum margin, head/lag, the missed-round counter, peer
+reachability/partition suspects, breaker states, ingress rejects,
+watcher sheds, sync stall, readiness.
+
+Counters are **delta-aware**: each sample records the cumulative value
+AND the delta vs the previous sample (clamped at ≥0, so a process
+restart's counter reset never reads as a negative spike). Rules over
+"did X increment this round?" read the delta; trend rules read the
+cumulative series.
+
+History survives restarts via an NDJSON spool with the OTLP-spool
+rotation pattern (obs/export.py): one line per sample, rotate to
+``<path>.1`` past the byte cap, read back with
+:func:`drand_tpu.obs.export.read_spool` — a consumer of the OTLP spool
+already knows how to read this one. Durability contract: writes are
+buffered (a flush syscall between two pairing verifies costs real
+milliseconds on overlay filesystems) and flushed every
+``FLUSH_EVERY`` samples; every incident mint/close force-flushes, so
+a SIGKILL can lose at most ``FLUSH_EVERY`` *healthy* samples — never
+the window around a detection.
+
+Sampling is cheap by construction: dict reads off the health/flight
+snapshots, three bounded ``collect()`` walks over the relevant metric
+families, one optional file append. No pairing-class work, no awaits
+(``bench.py incident_overhead`` proves ≤2% on a 64-round follow).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import deque
+
+# counter-valued sample keys whose per-sample delta the rules consume
+COUNTER_KEYS = ("missed_total", "ingress_rejects", "watcher_shed")
+
+
+def _counter_total(metric) -> float:
+    """Cumulative value of a prometheus Counter summed over its label
+    combinations (the ``_created`` bookkeeping samples are skipped)."""
+    total = 0.0
+    for fam in metric.collect():
+        for s in fam.samples:
+            if s.name.endswith("_total"):
+                total += s.value
+    return total
+
+
+def _gauge_by_label(metric, label: str) -> dict[str, float]:
+    """Current per-label values of a labelled prometheus Gauge."""
+    out: dict[str, float] = {}
+    for fam in metric.collect():
+        for s in fam.samples:
+            key = s.labels.get(label)
+            if key is not None:
+                out[key] = s.value
+    return out
+
+
+def collect_sample(now: float, *, flight, health, period: float | None,
+                   round_no: int | None = None) -> dict:
+    """One flat SLI sample off the live surfaces: the health snapshot
+    (head/lag/missed/stall/readiness), the flight recorder's newest
+    round record (quorum margin + its round), reachability suspects,
+    the global breaker-state gauge, and the flood/shed counters. The
+    caller owns WHEN (round boundary or probe); this function only
+    reads."""
+    from .. import metrics
+    from .health import is_ready
+
+    snap = health.snapshot()
+    margin = None
+    flight_round = None
+    for rec in flight.rounds(1):
+        margin = rec.get("margin_s")
+        flight_round = rec.get("round")
+    reach = flight.reachability()
+    breakers = _gauge_by_label(metrics.PEER_BREAKER_STATE, "index")
+    sample = {
+        "t": round(now, 6),
+        "round": round_no,
+        "head": snap["head_round"],
+        "lag": snap["lag_rounds"],
+        "missed_total": snap["missed_total"],
+        "sync_stalled": bool(snap["sync_stalled"]),
+        "ready": bool(snap["dkg_complete"]) and is_ready(snap),
+        "margin_s": margin,
+        "flight_round": flight_round,
+        "suspects": sum(1 for up in reach.values() if not up),
+        "breakers_open": sum(1 for v in breakers.values() if v >= 2),
+        "ingress_rejects": _counter_total(metrics.INGRESS_REJECTS),
+        "watcher_shed": _counter_total(metrics.RELAY_SHED),
+    }
+    if period is not None:
+        sample["period"] = period
+    return sample
+
+
+class TimeSeriesRing:
+    """Bounded sample ring + optional NDJSON disk spool.
+
+    ``append`` computes the counter deltas against the PREVIOUS sample
+    (spool-restored history counts: a restart's first live sample
+    deltas against the last spooled one, clamped at ≥0 because the
+    in-process counters restarted at zero)."""
+
+    def __init__(self, max_samples: int = 512,
+                 spool_path: str | None = None,
+                 max_spool_bytes: int = 4 << 20):
+        self.max_samples = max_samples
+        self.spool_path = spool_path
+        self.max_spool_bytes = max_spool_bytes
+        self._lock = threading.Lock()
+        self._ring: deque[dict] = deque(maxlen=max_samples)
+        # cached append handle + tracked size: one buffered write per
+        # sample instead of makedirs/stat/open/flush per line (each fs
+        # syscall between two pairing verifies costs ~2-4 ms on this
+        # box's overlay fs — bench incident_overhead's 2% bar caught
+        # it). Flushed every FLUSH_EVERY samples, on rotation/close,
+        # and explicitly when an incident is minted (forensic moments
+        # get durability; steady state gets the buffer).
+        self._spool_f = None
+        self._spool_size = 0
+        self._spool_unflushed = 0
+
+    FLUSH_EVERY = 32
+
+    def set_spool(self, path: str | None) -> None:
+        """Swap the spool target (closes any cached handle first)."""
+        with self._lock:
+            if self._spool_f is not None and not self._spool_f.closed:
+                try:
+                    self._spool_f.close()
+                except OSError:
+                    pass
+            self._spool_f = None
+            self._spool_size = 0
+            self.spool_path = path
+
+    # ------------------------------------------------------------ inputs
+    def append(self, sample: dict) -> dict:
+        """Delta-annotate ``sample``, ring it, spool it. Returns the
+        annotated sample (the one the rules see)."""
+        with self._lock:
+            prev = self._ring[-1] if self._ring else None
+            deltas = {}
+            for key in COUNTER_KEYS:
+                cur = sample.get(key)
+                if cur is None:
+                    deltas[key] = 0.0
+                    continue
+                base = prev.get(key) if prev else None
+                deltas[key] = max(0.0, cur - base) if base is not None \
+                    else 0.0
+            sample = dict(sample)
+            sample["deltas"] = deltas
+            self._ring.append(sample)
+        self._spool(sample)
+        return sample
+
+    def load_spool(self) -> int:
+        """Restore ring state from the spool (newest ``max_samples``
+        lines win). Returns how many samples were restored — restart
+        persistence for trend rules and post-mortem windows."""
+        if not self.spool_path:
+            return 0
+        from .export import read_spool
+
+        self.flush()  # read-your-writes within one process
+        docs = [d for d in read_spool(self.spool_path)
+                if isinstance(d, dict) and "t" in d]
+        if not docs:
+            return 0
+        with self._lock:
+            for d in docs[-self.max_samples:]:
+                d.setdefault("deltas",
+                             dict.fromkeys(COUNTER_KEYS, 0.0))
+                # restored samples are HISTORY, not live observations:
+                # state-flip rules (readiness_flip) must not treat a
+                # pre-restart "ready" as a live baseline, or every
+                # restart that needs catch-up mints a spurious critical
+                d["restored"] = True
+                self._ring.append(d)
+            return len(self._ring)
+
+    def _spool(self, sample: dict) -> None:
+        """The OTLP-spool pattern (obs/export.py): append one NDJSON
+        line, rotate to ``.1`` past the cap — disk bounded at ~2x. The
+        handle is opened once and kept (size tracked in memory); each
+        line is flushed so a crash loses at most the torn final line
+        read_spool already tolerates."""
+        if not self.spool_path:
+            return
+        line = json.dumps(sample, separators=(",", ":")) + "\n"
+        try:
+            with self._lock:
+                if self._spool_f is None or self._spool_f.closed:
+                    d = os.path.dirname(self.spool_path)
+                    if d:
+                        os.makedirs(d, exist_ok=True)
+                    self._spool_f = open(self.spool_path, "a",
+                                         encoding="utf-8")
+                    self._spool_size = self._spool_f.tell()
+                if self._spool_size + len(line) > self.max_spool_bytes \
+                        and self._spool_size > 0:
+                    self._spool_f.close()
+                    os.replace(self.spool_path, self.spool_path + ".1")
+                    self._spool_f = open(self.spool_path, "a",
+                                         encoding="utf-8")
+                    self._spool_size = 0
+                    self._spool_unflushed = 0
+                self._spool_f.write(line)
+                self._spool_size += len(line)
+                self._spool_unflushed += 1
+                if self._spool_unflushed >= self.FLUSH_EVERY:
+                    self._spool_f.flush()
+                    self._spool_unflushed = 0
+        except OSError:
+            pass  # forensics must never take the beacon plane down
+
+    def flush(self) -> None:
+        """Force buffered spool lines to disk (incident mints, tests,
+        graceful handover)."""
+        with self._lock:
+            if self._spool_f is not None and not self._spool_f.closed:
+                try:
+                    self._spool_f.flush()
+                    self._spool_unflushed = 0
+                except OSError:
+                    pass
+
+    # ------------------------------------------------------------ outputs
+    def window(self, n: int | None = None) -> list[dict]:
+        """The last ``n`` samples (all when None), oldest first."""
+        with self._lock:
+            samples = list(self._ring)
+        return samples if n is None else samples[-n:]
+
+    def last(self) -> dict | None:
+        with self._lock:
+            return self._ring[-1] if self._ring else None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()

@@ -654,10 +654,16 @@ class ChaosBeaconNetwork:
             raise ValueError(f"unknown fault action: {ev.action}")
 
     async def run_schedule(self, schedule: list[FaultEvent], rounds: int,
-                           probe: int = 0) -> list[RoundObservation]:
+                           probe: int = 0,
+                           on_round=None) -> list[RoundObservation]:
         """Advance ``rounds`` rounds, applying each event just before
         advancing into its ``at_round``; returns per-round observations
-        read off the probe's observability surfaces."""
+        read off the probe's observability surfaces.
+
+        ``on_round(round_no, now)`` — optional per-round-boundary hook
+        run AFTER the probe observation (so health gauges are fresh):
+        the incident-engine proof harness (ISSUE 15) drives its sampler
+        here, exactly where a live node's store/probe hooks would."""
         by_round: dict[int, list[FaultEvent]] = {}
         for ev in schedule:
             by_round.setdefault(ev.at_round, []).append(ev)
@@ -670,6 +676,8 @@ class ChaosBeaconNetwork:
                 await self.apply(ev)
             advanced = await self.advance_round()
             out.append(self.observe(advanced, probe))
+            if on_round is not None:
+                on_round(advanced, self.clocks[probe].now())
         return out
 
     # ---------------------------------------------------------- reshare

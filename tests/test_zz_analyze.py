@@ -306,6 +306,33 @@ def test_secretflow_catches_secret_logging_chaos_scenario(tmp_path):
     assert all(f.path == "testing/chaos_ext.py" for f in findings)
 
 
+def test_secretflow_bundle_writer_sink(tmp_path):
+    """ISSUE 15 satellite: the incident/forensic bundle writers are a
+    registered sink class — a pri_share routed into a bundle lands on
+    disk and travels to whoever reads the post-mortem, exfiltration
+    exactly like logging it. Known-bad: secret args into the writer
+    calls (bare and method forms) are HIGH. Known-good: telemetry
+    fields through the same writers stay clean."""
+    proj = _project(tmp_path, {
+        "obs/leaky.py": """
+            def on_trigger(mgr, share, rule):
+                pri_share = share.pri_share
+                mgr.capture_bundle(reason=str(pri_share))
+                freeze_bundle(rule, evidence=pri_share)
+        """,
+        "obs/clean_bundle.py": """
+            def on_trigger(mgr, flight, health, rule):
+                bundle = freeze_bundle(rule, flight=flight.rounds(8),
+                                       health=health.snapshot())
+                mgr.write_bundle(rule.name, bundle)
+        """,
+    })
+    findings = secretflow.run(proj)
+    assert [f.path for f in findings] == ["obs/leaky.py"] * 2
+    assert all(f.rule == "secret-in-bundle" for f in findings)
+    assert all(f.severity == "high" for f in findings)
+
+
 # ---------------------------------------------------------------------------
 # jaxhazard
 # ---------------------------------------------------------------------------

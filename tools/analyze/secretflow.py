@@ -5,7 +5,11 @@ secrets, ECIES/HKDF-derived keys, setup secrets) plus anything assigned
 from such an identifier within the same function. Sinks are the places
 an operator — or anyone scraping /metrics, /debug/trace or the logs —
 can read: logger calls, ``print``, metric ``.labels(...)`` values,
-exception constructor arguments, and trace-span attributes.
+exception constructor arguments, trace-span attributes, and the
+incident/forensic **bundle writers** (obs/incident.py, ISSUE 15) —
+bundles are written to disk and shipped to whoever handles the
+post-mortem, so a ``pri_share`` flowing into one is exfiltration
+exactly like logging it.
 
 A name bound to an imported MODULE never taints (the ``secrets`` stdlib
 module is the obvious trap), and string constants never taint — only
@@ -34,6 +38,19 @@ _LOG_METHODS = {"debug", "info", "warn", "warning", "error", "exception",
 _CONVERTERS = {"str", "bytes", "hex", "repr", "format", "int", "dumps",
                "hexlify", "b64encode", "b16encode", "to_bytes", "to_json",
                "join", "encode", "decode"}
+
+# the forensic-bundle writer sink class (obs/incident.py): any call to
+# one of these — bare or as a method, leading underscores stripped —
+# with a secret-named argument is a HIGH finding. Bundles land on disk
+# and travel to operators/support, the same trust boundary as a log
+# line (the known-bad fixture lives in tests/test_zz_analyze.py).
+_BUNDLE_SINKS = {"freeze_bundle", "write_bundle", "capture_bundle",
+                 "persist_bundle", "support_bundle", "freeze_locked",
+                 "persist_locked"}
+
+
+def _is_bundle_sink(name: str | None) -> bool:
+    return name is not None and name.lstrip("_") in _BUNDLE_SINKS
 
 
 def _is_module_alias(name: str, fn: FuncInfo) -> bool:
@@ -157,11 +174,22 @@ def _scan_function(fn: FuncInfo) -> list[Finding]:
                         if names:
                             emit("secret-in-trace-attr", child.lineno,
                                  names, "a trace-span attribute")
-                elif isinstance(func, ast.Name) and func.id == "print":
-                    names = check_call_args(child)
-                    if names:
-                        emit("secret-in-print", child.lineno, names,
-                             "stdout")
+                    elif _is_bundle_sink(func.attr):
+                        names = check_call_args(child)
+                        if names:
+                            emit("secret-in-bundle", child.lineno,
+                                 names, "a forensic bundle")
+                elif isinstance(func, ast.Name):
+                    if func.id == "print":
+                        names = check_call_args(child)
+                        if names:
+                            emit("secret-in-print", child.lineno, names,
+                                 "stdout")
+                    elif _is_bundle_sink(func.id):
+                        names = check_call_args(child)
+                        if names:
+                            emit("secret-in-bundle", child.lineno,
+                                 names, "a forensic bundle")
             walk(child)
 
     for stmt in fn.node.body:

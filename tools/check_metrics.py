@@ -104,6 +104,16 @@ KNOWN_LABEL_VALUES = {
     "relay_wakeups_total": {"proto": {"sse", "ndjson"}},
     "relay_shed_total": {"reason": {"watcher_cap", "slow_consumer"}},
     "chain_store_reads_total": {"backend": {"sqlite", "segment"}},
+    # incident engine (ISSUE 15): every rule carries its canonical
+    # severity at a branch-literal call site (obs/incident.py
+    # _incident_counter — the flight.py label-helper pattern); unknown
+    # operator rules collapse to rule="custom"
+    "incidents_total": {
+        "rule": {"missed_round", "readiness_flip", "breaker_open",
+                 "reachability_drop", "sync_stall", "margin_degraded",
+                 "ingress_flood", "shed_surge", "custom"},
+        "severity": {"critical", "major", "warning"},
+    },
 }
 
 

@@ -59,6 +59,18 @@
 #                          on the wall clock but runs no DKG and no
 #                          reshare timers — no contention pair needed.
 #   test_zz_flight.py      threshold flight recorder suite (host-only)
+#   test_zz_incident.py    incident engine: chaos-driven detector
+#                          matrix, ts-ring/bundle rotation, restart
+#                          persistence, bundle hygiene, ?n= matrix
+#                          (host-only; structural crypto + one real
+#                          share synthesis, no pairings, no compiles;
+#                          ~5 s). CONFLICTS evaluation vs
+#                          test_zz_chaos/test_zz_analyze: same
+#                          structural-crypto FakeClock harness (~7 s
+#                          CPU, no wall-clock timers, no DKG/reshare
+#                          phasers) and its own recorder instances —
+#                          coexists in one chunk fine; no pair entry
+#                          needed.
 #   test_zz_obs_health.py  chain-health SLO / OTLP export suite
 #   test_zz_selfheal.py    self-healing plane: retry policy, breakers,
 #                          quorum repair, stale serving (host-only,
