@@ -28,13 +28,13 @@ Environment knobs:
     BENCH_MIN_SECONDS  minimum timed window per trial (default 5.0)
     BENCH_TRIALS       trials per config (default 2; best wins)
     BENCH_CONFIGS      comma list to run: any of
-                       msm,glv4,rlc,obs,flight,incident,chaos,timelock,
-                       fanout,segstore,shard,e2e,catchup,recover,deal,
-                       replay,headline
+                       msm,glv4,rlc,obs,flight,incident,remediate,chaos,
+                       timelock,fanout,segstore,shard,e2e,catchup,recover,
+                       deal,replay,headline
                        (default: all; msm, glv4, rlc, obs, flight,
-                       incident, chaos, timelock, fanout and segstore
-                       are host-only and run FIRST, before backend init, so
-                       they report even with the TPU tunnel down —
+                       incident, remediate, chaos, timelock, fanout and
+                       segstore are host-only and run FIRST, before backend
+                       init, so they report even with the TPU tunnel down —
                        shard re-execs onto the virtual CPU mesh and is
                        bounded by the remaining budget)
     BENCH_CHAOS_N      chaos_soak network size (default 32)
@@ -648,6 +648,94 @@ def bench_incident_overhead(trials):
             "vs_baseline": None}
 
 
+def bench_remediation_overhead(trials):
+    """Remediation-engine overhead A/B on a fault-free 64-round follow
+    (ISSUE 16): the incident_overhead loop with the PlaybookEngine
+    attached LIVE on top. On a healthy chain no rule fires, so the
+    engine's cost is exactly the closed-loop hook — the manager's
+    event hand-off check per sample — which is what a production node
+    pays for having auto-remediation armed while nothing is wrong.
+    Pure host crypto, runs before backend init; acceptance is ≤2%
+    marginal over the incident-armed baseline."""
+    import tempfile
+
+    from drand_tpu.chain import beacon as chain_beacon
+    from drand_tpu.chain.beacon import Beacon, message
+    from drand_tpu.crypto import bls
+    from drand_tpu.obs.flight import FlightRecorder
+    from drand_tpu.obs.health import HealthState
+    from drand_tpu.obs.incident import IncidentManager
+    from drand_tpu.obs.remediate import PlaybookEngine
+    from drand_tpu.obs.timeseries import TimeSeriesRing
+
+    span, t_of_n = 64, 3
+    period, genesis = 10, 1_000_000
+    sk, pub = bls.keygen(seed=b"bench-remediate")
+    prev, beacons = b"\x54" * 32, []
+    for rnd in range(1, span + 1):
+        sig = bls.sign(sk, message(rnd, prev))  # warms the h2c memo too
+        beacons.append(Beacon(round=rnd, previous_sig=prev, signature=sig))
+        prev = sig
+
+    def make_manager():
+        flight = FlightRecorder()
+        health = HealthState()
+        health.note_dkg_complete()
+        spool = os.path.join(
+            tempfile.mkdtemp(prefix="drand-remediate-bench-"),
+            "ts.ndjson")
+        mgr = IncidentManager(flight=flight, health=health,
+                              ring=TimeSeriesRing(spool_path=spool))
+        return flight, health, mgr
+
+    flight_b, health_b, mgr_b = make_manager()          # incident-only
+    flight_a, health_a, mgr_a = make_manager()          # + live engine
+    engine = PlaybookEngine(dry_run=False)
+    engine.attach(mgr_a)
+
+    def timed(flight, health, mgr):
+        flight.reset()
+        health.reset()
+        health.note_dkg_complete()
+        mgr.reset()
+        engine.reset()
+        t0 = time.perf_counter()
+        for b in beacons:
+            boundary = genesis + (b.round - 1) * period
+            for idx in range(t_of_n):
+                flight.note_partial(
+                    b.round, index=idx, source="grpc", verdict="valid",
+                    now=boundary + 0.1 * idx, period=period,
+                    genesis=genesis, n=t_of_n + 1, threshold=t_of_n)
+            flight.note_quorum(b.round, have=t_of_n, threshold=t_of_n,
+                               now=boundary + 0.3, period=period,
+                               genesis=genesis)
+            if not chain_beacon.verify_beacon(pub, b):
+                raise RuntimeError("verification failed")
+            health.note_round_stored(b.round, 0.4, period)
+            health.observe_chain(boundary + 0.4, period, genesis, b.round)
+            mgr.on_round(b.round, now=boundary + 0.4, period=period)
+        return time.perf_counter() - t0
+
+    # interleaved min-of pairs (the incident_overhead pattern): both
+    # legs ride the same CPU-drift regime on the 1-core box
+    trials = max(2, min(trials, 3))
+    dt_bare = dt_armed = float("inf")
+    for _ in range(trials):
+        dt_bare = min(dt_bare, timed(flight_b, health_b, mgr_b))
+        dt_armed = min(dt_armed, timed(flight_a, health_a, mgr_a))
+    if len(mgr_a.incidents()) or len(engine.ledger(8)):
+        raise RuntimeError("remediation overhead leg was not fault-free")
+    overhead_pct = (dt_armed - dt_bare) / dt_bare * 100.0
+    return {"metric": "remediation_overhead",
+            "value": round(overhead_pct, 2), "unit": "%", "span": span,
+            "playbooks_armed": len(engine.playbooks),
+            "mode": "live",
+            "bare_seconds": round(dt_bare, 4),
+            "armed_seconds": round(dt_armed, 4),
+            "vs_baseline": None}
+
+
 def bench_chaos_soak(trials):
     """Chaos soak (ISSUE 11): a 32-node t=17 in-process beacon network
     on the FakeClock under a scripted fault schedule — healthy rounds,
@@ -717,6 +805,59 @@ def bench_chaos_soak(trials):
         finally:
             net.stop_all()
 
+    async def remediate_soak(live: bool):
+        """The ISSUE-16 MTTR variant: a worker dies mid-soak and NO
+        operator touches it. One leg with the remediation engine in
+        dry-run (the pre-ISSUE-16 plane: the incident mints and
+        annotates, the worker stays dead), one armed live (worker_down
+        incident -> respawn_worker playbook -> supervised restart ->
+        incident closes). MTTR = fault to the victim serving again,
+        read off the same round observations; None = never recovered.
+        Smaller net than the main soak — the comparison is the loop
+        closure, not scale."""
+        from drand_tpu.obs.incident import IncidentManager
+        from drand_tpu.obs.remediate import (PlaybookEngine,
+                                             attach_supervisor,
+                                             worker_down_rule)
+        from drand_tpu.utils.aio import spawn as aio_spawn
+        from drand_tpu.utils.supervise import Supervisor
+
+        rn = 8
+        rt = rn // 2 + 1
+        net = ChaosBeaconNetwork(n=rn, t=rt, period=period)
+        await net.start_all()
+        await net.advance_to_genesis()
+        victim = rn - 1
+        sup = Supervisor(clock=net.clocks[0], respawn_budget=3,
+                         backoff_base_s=period / 4)
+        sup.register(f"node-{victim}",
+                     is_alive=lambda: victim not in net.crashed,
+                     respawn=lambda: aio_spawn(net.restart(victim)))
+        mgr = IncidentManager(flight=net.flights[0],
+                              health=net.healths[0])
+        mgr.rules.append(worker_down_rule(sup, cooldown_s=period))
+        engine = PlaybookEngine(clock=net.clocks[0], dry_run=not live,
+                                max_actions=8, window_s=16 * period)
+        engine.attach(mgr)
+        attach_supervisor(engine, sup)
+        alive_round = [None]
+
+        def on_round(r, now):
+            mgr.on_round(r, now=now, period=period)
+            if alive_round[0] is None and r > fault_round \
+                    and victim not in net.crashed:
+                alive_round[0] = r
+
+        sched = [FaultEvent(fault_round, "crash", {"nodes": [victim]})]
+        try:
+            await net.run_schedule(sched, rounds=rounds,
+                                   on_round=on_round)
+        finally:
+            net.stop_all()
+        mttr = (None if alive_round[0] is None
+                else (alive_round[0] - fault_round) * period)
+        return mttr, mgr, engine, (victim in net.crashed)
+
     t0 = time.perf_counter()
     with structural_crypto(), isolated_observability():
         obs = asyncio.run(soak())
@@ -732,6 +873,14 @@ def bench_chaos_soak(trials):
     log("chaos_soak: drop-the-push variant, repair on")
     with structural_crypto(), isolated_observability():
         obs_on = asyncio.run(drop_soak(repair=True))
+    log("chaos_soak: worker-death MTTR, remediation off (dry-run)")
+    with structural_crypto(), isolated_observability():
+        mttr_off, _mgr_off, eng_off, dead_off = asyncio.run(
+            remediate_soak(live=False))
+    log("chaos_soak: worker-death MTTR, remediation on (live)")
+    with structural_crypto(), isolated_observability():
+        mttr_on, mgr_on, eng_on, dead_on = asyncio.run(
+            remediate_soak(live=True))
     wall = time.perf_counter() - t0
     missed_off = max(ob.missed_total for ob in obs_off)
     missed_on = max(ob.missed_total for ob in obs_on)
@@ -750,6 +899,32 @@ def bench_chaos_soak(trials):
         raise RuntimeError(
             f"repair variant regressed: recovery {rec_on}s with repair "
             f"vs {rec_off}s without")
+    # the remediation-off leg must leave the worker dead (dry-run only
+    # ANNOTATES) or the A/B proves nothing about the closed loop
+    if not dead_off or mttr_off is not None:
+        raise RuntimeError(
+            "remediation variant inconclusive: the worker came back "
+            f"without the engine armed (mttr={mttr_off})")
+    dry_entries = [e for e in eng_off.ledger(16)
+                   if e["playbook"] == "respawn_worker"]
+    if not dry_entries or any(e["outcome"] != "dry_run"
+                              for e in dry_entries):
+        raise RuntimeError(
+            "remediation variant inconclusive: dry-run leg did not "
+            f"annotate the respawn playbook (ledger={dry_entries})")
+    # the live leg is the CLAIM: worker_down incident -> respawn_worker
+    # -> supervised restart -> incident closes, strictly better MTTR
+    live_ok = [e for e in eng_on.ledger(16)
+               if e["playbook"] == "respawn_worker"
+               and e["outcome"] == "ok"]
+    closed = [inc for inc in mgr_on.incidents(16)
+              if inc["rule"] == "worker_down"
+              and inc["state"] == "closed"]
+    if dead_on or mttr_on is None or not live_ok or not closed:
+        raise RuntimeError(
+            f"remediation variant regressed: mttr={mttr_on} "
+            f"dead={dead_on} ledger_ok={len(live_ok)} "
+            f"closed={len(closed)}")
     return {"metric": "chaos_soak_detection_lead",
             "value": float(lead["lead_seconds"]), "unit": "s",
             "nodes": n, "threshold": t, "period_s": period,
@@ -765,6 +940,14 @@ def bench_chaos_soak(trials):
                 "missed_with_repair": missed_on,
                 "recovery_seconds_without_repair": rec_off,
                 "recovery_seconds_with_repair": rec_on,
+            },
+            "remediation": {
+                "schedule": "worker_death",
+                "mttr_seconds_without": mttr_off,
+                "mttr_seconds_with": mttr_on,
+                "incident_mttr_seconds": round(
+                    closed[0]["closed_at"] - closed[0]["opened_at"], 3),
+                "respawns_ok": len(live_ok),
             },
             "wall_seconds": round(wall, 1),
             "vs_baseline": None}
@@ -1371,8 +1554,8 @@ def main() -> None:
     t_start = time.perf_counter()
     which = os.environ.get(
         "BENCH_CONFIGS",
-        "msm,glv4,rlc,obs,flight,incident,chaos,timelock,fanout,segstore,"
-        "shard,e2e,catchup,recover,deal,replay,headline").split(",")
+        "msm,glv4,rlc,obs,flight,incident,remediate,chaos,timelock,fanout,"
+        "segstore,shard,e2e,catchup,recover,deal,replay,headline").split(",")
 
     # --- outage-proofing (round-3 lesson: the official record must never
     # be an unparseable traceback). Two layers:
@@ -1492,6 +1675,18 @@ def main() -> None:
 
             log(traceback.format_exc())
             diag("aux_config_failed", config="incident",
+                 error=f"{type(e).__name__}: {e}")
+
+    if "remediate" in which:
+        log("== remediation-engine overhead on a fault-free 64-round "
+            "follow ==")
+        try:
+            emit(bench_remediation_overhead(trials))
+        except Exception as e:  # noqa: BLE001 — best-effort aux config
+            import traceback
+
+            log(traceback.format_exc())
+            diag("aux_config_failed", config="remediate",
                  error=f"{type(e).__name__}: {e}")
 
     if "chaos" in which:

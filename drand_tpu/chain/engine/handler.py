@@ -16,7 +16,7 @@ from ...crypto import tbls
 from ...key.group import Group
 from ...key.keys import Node, Share
 from ...net.packets import PartialBeaconPacket, PartialRequest, SyncRequest
-from ...net.transport import (BreakerOpenError, PeerBreaker,
+from ...net.transport import (BREAKER_OPEN, BreakerOpenError, PeerBreaker,
                               PeerRejectedError, ProtocolClient,
                               ProtocolService, TransportError)
 from ...obs.flight import FLIGHT, FlightRecorder
@@ -135,6 +135,12 @@ class Handler(ProtocolService):
             cap_s=max(0.25, period / 8), deadline_s=period / 2)
         self._repairing: set[int] = set()
         self._repair_served: dict[str, tuple[int, int]] = {}
+        # remediation playbooks (ISSUE 16): short, deadline-free retry
+        # budget on the injectable clock — a playbook action is already
+        # cooldown-paced by the engine, so two tries is the whole budget
+        self._remediate_policy = RetryPolicy(
+            attempts=2, base_s=max(0.05, period / 8),
+            cap_s=max(0.1, period / 4))
 
     # ------------------------------------------------------------------ API
     async def start(self) -> None:
@@ -608,3 +614,117 @@ class Handler(ProtocolService):
             raise
         finally:
             self._repairing.discard(round_no)
+
+    # -------------------------------------------- remediation (ISSUE 16)
+    async def remediate_sync(self) -> str:
+        """The ``sync_resume`` playbook action: kick a catch-up follow
+        to the wall-clock round NOW. ``Syncer.follow`` itself is the
+        recovery primitive — it shuffles upstreams, fails over to the
+        next on error, and every attempt resumes from the stored
+        checkpoint (``store.last() + 1``), so this action never
+        re-fetches verified spans. Returns the ledger detail; raises
+        when the chain is still behind afterwards (the engine records
+        ``outcome=failed``)."""
+        g = self.conf.group
+        target = time_math.current_round(self.conf.clock.now(), g.period,
+                                         g.genesis_time)
+        start = self.chain.last().round
+        if start >= target:
+            return f"no lag: head already at round {start}"
+        peers = [nd.identity for nd in g.nodes
+                 if nd.address() != self.addr]
+
+        async def _attempt() -> None:
+            if self.chain.sync.syncing():
+                # a follow is already running and rotates upstreams on
+                # its own — don't stack a second one on the same store
+                return
+            if not await self.chain.sync.follow(target, peers) \
+                    and self.chain.last().round < target:
+                raise TransportError("sync resume: no upstream served "
+                                     "the missing span")
+
+        await retry(_attempt, op="sync", policy=self._remediate_policy,
+                    clock=self.conf.clock, retry_on=(TransportError,))
+        head = self.chain.last().round
+        if head < target:
+            raise TransportError(
+                f"sync resume stalled at round {head}/{target}")
+        return (f"resumed from checkpoint {start}: synced "
+                f"{head - start} round(s) to head {head}")
+
+    async def remediate_breakers(self) -> str:
+        """The ``quorum_pull`` playbook action for a persistent
+        breaker_open incident: for each OPEN peer breaker, spend one
+        half-open probe slot on a targeted quorum-repair
+        ``PartialRequest`` pull — the probe doubles as recovery (a
+        served pull both closes the breaker and back-fills the round).
+        Pulled packets re-enter through normal ingress verification.
+        Raises when every probed peer stayed silent (the fault holds —
+        the engine ledgers ``failed`` and the cooldown paces the next
+        probe)."""
+        g = self.conf.group
+
+        async def _pass() -> tuple[int, int, int]:
+            probed = answered = pulled = 0
+            last = self.chain.last()
+            round_no = last.round + 1
+            have = self.chain.partial_indices(round_no, last.signature)
+            for node in g.nodes:
+                if node.address() == self.addr:
+                    continue
+                br = self._breaker(node.index)
+                if br.state != BREAKER_OPEN:
+                    continue
+                if not br.allow(self.conf.clock.now()):
+                    continue  # probe slot already spent this cooldown
+                probed += 1
+                req = PartialRequest(round=round_no,
+                                     previous_sig=last.signature,
+                                     have=tuple(sorted(have)))
+                try:
+                    served = await self._client.request_partials(
+                        node.identity, req)
+                except asyncio.CancelledError:
+                    raise
+                except PeerRejectedError:
+                    # an answered refusal closes the breaker: the peer
+                    # is back even if it won't serve this round
+                    br.record(True, self.conf.clock.now())
+                    answered += 1
+                    continue
+                except TransportError:
+                    br.record(False, self.conf.clock.now())
+                    continue
+                except Exception:  # transports without the RPC
+                    br.record(True, self.conf.clock.now())
+                    answered += 1
+                    continue
+                br.record(True, self.conf.clock.now())
+                answered += 1
+                for p in served[: len(g)]:
+                    try:
+                        idx = tbls.index_of(p.partial_sig)
+                    except ValueError:
+                        continue
+                    if idx in have:
+                        continue
+                    try:
+                        await self.process_partial_beacon(
+                            node.address(), p)
+                    except TransportError:
+                        continue  # dupes/garbage: counted by ingress
+                    have.add(idx)
+                    pulled += 1
+            if probed > 0 and answered == 0:
+                raise TransportError(
+                    f"all {probed} open-breaker probe(s) unanswered")
+            return probed, answered, pulled
+
+        probed, answered, pulled = await retry(
+            _pass, op="repair", policy=self._remediate_policy,
+            clock=self.conf.clock, retry_on=(TransportError,))
+        if probed == 0:
+            return "no open breakers with a free probe slot"
+        return (f"probed {probed} open breaker(s): {answered} answered, "
+                f"{pulled} partial(s) pulled")

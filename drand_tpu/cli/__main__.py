@@ -20,6 +20,7 @@ through its localhost control port (cmd/drand-cli/control.go), exactly like
     python -m drand_tpu.cli util flight --url http://host:port [--dkg]
     python -m drand_tpu.cli util incidents --url http://host:port [--show ID] [--bundle ID -o FILE]
     python -m drand_tpu.cli util support-bundle --url http://host:port -o FILE
+    python -m drand_tpu.cli util remediate --url http://host:port [--n K]
     python -m drand_tpu.cli stop --control PORT
 """
 
@@ -188,6 +189,14 @@ async def _serve_public(d, listen: str, logger, folder: str,
                           peer_metrics_fn=peer_metrics,
                           enable_pprof=os.environ.get("DRAND_TPU_PPROF") == "1",
                           timelock_service=tl_service)
+    # auto-remediation (ISSUE 16): the daemon's embedded public server
+    # has the same partition-posture knobs as a relay — register the
+    # posture action so reachability_drop doesn't refuse with
+    # "no action registered" on daemons
+    from ..obs.remediate import attach_posture
+    from ..obs.remediate import configure_from_env as _remediate_env
+
+    attach_posture(_remediate_env(), server)
     await server.start(host or "0.0.0.0", int(port))
     logger.info("http", "serving", listen=listen, timelock=timelock)
     await asyncio.Event().wait()
@@ -494,6 +503,40 @@ def _print_incidents(data: dict) -> None:
               f"{inc.get('detail', '')}")
 
 
+def _print_remediation(data: dict) -> None:
+    """Render /debug/remediation: engine posture + guardrails, then
+    the ledger newest-first."""
+    budget = data.get("budget") or {}
+    print(f"remediation mode: {data.get('mode')}  "
+          f"budget {budget.get('used', 0)}/{budget.get('max', '?')} "
+          f"per {budget.get('window_s', '?')}s  "
+          f"attached={data.get('attached')}")
+    active = data.get("active") or {}
+    if active:
+        for name, inc in sorted(active.items()):
+            print(f"  active: {name} on {inc}")
+    for pb in data.get("playbooks", []):
+        marks = []
+        if pb.get("annotate_only"):
+            marks.append("annotate-only")
+        if not pb.get("registered"):
+            marks.append("UNREGISTERED")
+        suffix = f"  [{', '.join(marks)}]" if marks else ""
+        print(f"  {pb.get('playbook', '?'):<18} <- "
+              f"{pb.get('rule', '?'):<18} "
+              f"cooldown={pb.get('cooldown_s')}s "
+              f"min_fired={pb.get('min_fired')}{suffix}")
+    ledger = data.get("ledger", [])
+    if not ledger:
+        print("ledger: empty (no playbook has triggered)")
+        return
+    print(f"ledger ({len(ledger)} newest-first):")
+    for e in ledger:
+        print(f"  t={e.get('t')} {e.get('playbook', '?'):<18} "
+              f"{e.get('outcome', '?'):<16} inc={e.get('incident')} "
+              f"{e.get('detail', '')}")
+
+
 def _print_incident_bundle(bundle: dict) -> None:
     """Render one incident's forensic bundle (headline + evidence
     inventory — `--json`/`-o` carry the full payload)."""
@@ -651,6 +694,21 @@ def cmd_util(args) -> None:
                                 _print_incidents)
 
         asyncio.run(run_incidents())
+        return
+    if args.what == "remediate":
+        # auto-remediation plane (ISSUE 16): engine mode, budget,
+        # active playbooks and the action ledger over /debug/remediation
+        if not args.url:
+            raise SystemExit("util remediate requires --url "
+                             "http://host:port")
+
+        async def run_remediate():
+            data = await _fetch_json(args.url, "/debug/remediation",
+                                     n=args.n)
+            _write_or_print(data, args.out, args.json,
+                            _print_remediation)
+
+        asyncio.run(run_remediate())
         return
     if args.what == "support-bundle":
         # one-shot manual forensic capture (ISSUE 15): the node runs
@@ -891,6 +949,12 @@ def cmd_relay(args) -> None:
         server = PublicServer(
             client, timelock_service=tl_service,
             timelock_sweep=not args.no_timelock_sweep)
+        # auto-remediation (ISSUE 16): the relay's playbook is partition
+        # posture — dry-run by default, DRAND_TPU_REMEDIATE=live arms it
+        from ..obs.remediate import attach_posture
+        from ..obs.remediate import configure_from_env as _remediate_env
+
+        attach_posture(_remediate_env(), server)
         host, port = args.listen.rsplit(":", 1)
         await server.start(host or "0.0.0.0", int(port),
                            reuse_port=args.reuse_port)
@@ -913,10 +977,14 @@ def _relay_parent(args) -> None:
     exiting does NOT take the port down — the survivors keep serving
     their watchers (the worker-smoke contract); the parent exits when
     every worker has. SIGTERM/SIGINT fan out to the workers so the
-    whole group drains together."""
+    whole group drains together. Sweeper respawn rides the shared
+    ``utils.supervise.Supervisor`` (the same budget policy the
+    auto-remediation respawn playbook uses)."""
     import signal
     import subprocess
     import time as _time
+
+    from ..utils.supervise import Supervisor
 
     argv = [sys.executable, "-m", "drand_tpu.cli", "relay",
             "--url", args.url, "--listen", args.listen,
@@ -954,23 +1022,28 @@ def _relay_parent(args) -> None:
 
     signal.signal(signal.SIGTERM, _fan_out)
     signal.signal(signal.SIGINT, _fan_out)
-    respawns = 0
+
+    # a dead SWEEPER would silently stop vault round-opens while the
+    # survivors keep serving — respawn it through the shared bounded
+    # supervisor (a crash-looping sweeper must not fork-bomb the box)
+    def _respawn_sweeper() -> None:
+        nonlocal sweeper, crashed
+        old_rc = sweeper.returncode
+        crashed = crashed or old_rc != 0
+        sweeper = _spawn(sweeper=True)
+        procs.append(sweeper)
+        print(f"relay parent: sweeper died (rc={old_rc}), "
+              f"respawned pid={sweeper.pid} "
+              f"({sup.respawns('sweeper')}/{sup.respawn_budget})",
+              flush=True)
+
+    sup = Supervisor(respawn_budget=5, backoff_base_s=0.0)
+    sup.register("sweeper", is_alive=lambda: sweeper.poll() is None,
+                 respawn=_respawn_sweeper)
     while any(p.poll() is None for p in procs):
-        # a dead SWEEPER would silently stop vault round-opens while
-        # the survivors keep serving — respawn it (bounded: a
-        # crash-looping sweeper must not fork-bomb the box)
         if (args.timelock_db and not stopping
-                and sweeper.poll() is not None
-                and any(p.poll() is None for p in procs)
-                and respawns < 5):
-            respawns += 1
-            old_rc = sweeper.returncode
-            crashed = crashed or old_rc != 0
-            sweeper = _spawn(sweeper=True)
-            procs.append(sweeper)
-            print(f"relay parent: sweeper died (rc={old_rc}), "
-                  f"respawned pid={sweeper.pid} ({respawns}/5)",
-                  flush=True)
+                and any(p.poll() is None for p in procs)):
+            sup.maybe_respawn("sweeper")
         _time.sleep(0.2)
     # any worker that did not exit cleanly — including signal deaths,
     # whose returncode is NEGATIVE — must surface to the supervisor;
@@ -1346,7 +1419,8 @@ def main(argv=None) -> None:
     u.add_argument("what", choices=["ping", "check", "del-beacon",
                                     "self-sign", "reset", "trace",
                                     "engine", "flight", "store-migrate",
-                                    "incidents", "support-bundle"])
+                                    "incidents", "support-bundle",
+                                    "remediate"])
     u.add_argument("--control", type=int, default=8888)
     u.add_argument("--address")
     u.add_argument("--folder")
@@ -1360,7 +1434,8 @@ def main(argv=None) -> None:
                         "cross-node timeline")
     u.add_argument("--n", type=int, default=8,
                    help="round timelines/flight records/incident "
-                        "summaries to fetch (trace/flight/incidents)")
+                        "summaries/ledger entries to fetch "
+                        "(trace/flight/incidents/remediate)")
     u.add_argument("--dkg", action="store_true",
                    help="flight: show the DKG phase timeline instead "
                         "of the round matrix")

@@ -472,6 +472,13 @@ class Drand(ProtocolService):
                               logger=self._l.named("beacon"))
         for cb in self.conf.beacon_callbacks:
             self.beacon.chain.add_callback(f"conf-{id(cb)}", cb)
+        # auto-remediation (ISSUE 16): wire the node playbooks
+        # (sync_resume, quorum_pull, reshare_recommend) onto this
+        # handler — dry-run unless DRAND_TPU_REMEDIATE=live
+        from ..obs.remediate import attach_node
+        from ..obs.remediate import configure_from_env as _remediate_env
+
+        attach_node(_remediate_env(), self.beacon)
 
     def _require_loaded(self) -> tuple[Group, Share]:
         if self.group is None or self.share is None:

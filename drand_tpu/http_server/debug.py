@@ -51,6 +51,7 @@ def add_trace_routes(app: web.Application) -> None:
         web.get("/debug/incidents", _incidents),
         web.get("/debug/incidents/{id}", _incident_bundle),
         web.get("/debug/support-bundle", _support_bundle),
+        web.get("/debug/remediation", _remediation),
     ])
 
 
@@ -100,6 +101,21 @@ async def _incidents(request: web.Request) -> web.Response:
     return web.json_response({"incidents": INCIDENTS.incidents(n),
                               "active": INCIDENTS.active_count(),
                               "samples": len(INCIDENTS.ring)})
+
+
+async def _remediation(request: web.Request) -> web.Response:
+    """The auto-remediation plane (ISSUE 16): engine mode (dry-run vs
+    live), the action budget, active playbooks + cooldowns, and the
+    last ``n`` remediation-ledger entries (`drand-tpu util remediate`
+    renders this). ``n`` validates via the shared obs.query.ring_n
+    helper like every other ring route."""
+    from ..obs.query import ring_n
+    from ..obs.remediate import ENGINE
+
+    n = ring_n(request.query.get("n"), default=32, cap=ENGINE.ledger_max)
+    if n is None:
+        return web.json_response({"error": "bad n"}, status=400)
+    return web.json_response(ENGINE.status(n))
 
 
 async def _incident_bundle(request: web.Request) -> web.Response:

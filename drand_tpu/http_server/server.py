@@ -46,6 +46,12 @@ _WATCH_RETRY = RetryPolicy(attempts=6, base_s=0.5, cap_s=15.0)
 DEFAULT_MAX_WATCHERS = int(os.environ.get(
     "DRAND_TPU_RELAY_MAX_WATCHERS", "4096"))
 
+# partition posture (ISSUE 16): the watcher cap is multiplied by this
+# while the posture holds — a minority-partition relay serving stale
+# data should also carry fewer streams, so capacity stays for the
+# pollers that tolerate staleness
+POSTURE_WATCHER_FRACTION = 0.5
+
 
 def _etag_matches(if_none_match: str | None, etag: str) -> bool:
     """RFC 7232 If-None-Match: member-wise WEAK comparison — caches
@@ -112,6 +118,12 @@ class PublicServer:
         self._hub = fanout.FanoutHub(queue_max=fanout_queue_max)
         self._max_watchers = (max_watchers if max_watchers is not None
                               else DEFAULT_MAX_WATCHERS)
+        # partition posture (ISSUE 16): applied by the remediation
+        # engine on a majority reachability drop, reverted on incident
+        # close — serve stale from the cache without hammering the dead
+        # upstream, and shed new watchers earlier
+        self._posture = False
+        self._max_watchers_normal = self._max_watchers
         # last successfully fetched chain info: the stale-serving path
         # computes the X-Drand-Stale lag from it after the upstream dies
         self._info_cache = None
@@ -285,6 +297,12 @@ class PublicServer:
         proto = self._stream_proto(request)
         if proto is not None:
             return await self._handle_latest_stream(request, proto)
+        if self._posture and self._latest is not None:
+            # partition posture: the upstream is known-partitioned —
+            # serve the last-known beacon (X-Drand-Stale) immediately
+            # instead of paying a doomed upstream round-trip per poll
+            return await self._stale_or_error(
+                ClientError("partition posture"))
         try:
             r = await self._client.get(0)
         except ClientError as e:
@@ -403,6 +421,30 @@ class PublicServer:
         finally:
             self._hub.unsubscribe(sub)
         return resp
+
+    def set_partition_posture(self, on: bool) -> str:
+        """Apply/revert partition posture (the ``partition_posture``
+        remediation playbook): while on, ``/public/latest`` serves the
+        last-known beacon from the cache (the ``X-Drand-Stale`` path)
+        without trying the partitioned upstream, and the watcher-shed
+        cap drops to ``POSTURE_WATCHER_FRACTION`` of normal. Idempotent
+        both ways; returns the ledger detail."""
+        if on:
+            if self._posture:
+                return "partition posture already on"
+            self._posture = True
+            self._max_watchers_normal = self._max_watchers
+            self._max_watchers = max(
+                1, int(self._max_watchers * POSTURE_WATCHER_FRACTION))
+            return (f"partition posture on: serving stale from cache, "
+                    f"watcher cap {self._max_watchers_normal} -> "
+                    f"{self._max_watchers}")
+        if not self._posture:
+            return "partition posture already off"
+        self._posture = False
+        self._max_watchers = self._max_watchers_normal
+        return (f"partition posture off: live serving restored, "
+                f"watcher cap back to {self._max_watchers}")
 
     async def _stale_or_error(self, err: ClientError) -> web.Response:
         """Degraded-mode serving (ISSUE 12): when the upstream is lost

@@ -129,6 +129,18 @@ incidents with frozen forensic bundles):
       (re-fires extend the open incident, cooldown suppresses flaps)
   incident_active                      [group]   currently open
       incidents (their rules still firing or not yet cleared)
+Auto-remediation (obs/remediate.py, ISSUE 16 — the closed loop:
+incidents drive guardrailed playbooks, every attempt audited):
+  remediation_actions_total{playbook,outcome} [group]  remediation-
+      ledger entries by playbook (sync_resume | quorum_pull |
+      partition_posture | respawn_worker | reshare_recommend) and
+      outcome (ok | failed | dry_run | budget_exhausted | reverted) —
+      dry_run is the default posture until DRAND_TPU_REMEDIATE=live
+  remediation_active{playbook}         [group]   playbooks holding an
+      action in flight or a sticky posture (partition_posture stays 1
+      until its incident closes and the revert runs)
+  remediation_mttr_seconds             [group]   open-to-close of
+      incidents the engine acted on — MTTR as a measured SLI
 Edge fan-out set (http_server/fanout.py hub + chain/segments.py,
 ISSUE 14 — the push tier on /public/latest and the packed segment
 chain store behind it):
@@ -425,6 +437,28 @@ INCIDENT_ACTIVE = Gauge(
     "Currently open incidents: their rules are still firing or have "
     "not yet stayed quiet for the clear window",
     registry=GROUP_REGISTRY)
+# ---- auto-remediation (obs/remediate.py, ISSUE 16) ------------------------
+REMEDIATION_ACTIONS = Counter(
+    "remediation_actions_total",
+    "Remediation-ledger entries by playbook and outcome (ok = action "
+    "ran / recommendation written; failed = action raised; dry_run = "
+    "engine not armed, annotated what it WOULD do; budget_exhausted = "
+    "the global actions-per-window budget refused it; reverted = a "
+    "sticky playbook's revert ran when its incident closed)",
+    ["playbook", "outcome"], registry=GROUP_REGISTRY)
+REMEDIATION_ACTIVE = Gauge(
+    "remediation_active",
+    "Playbooks currently holding an action in flight or a sticky "
+    "posture (1 while held; partition_posture stays 1 until the "
+    "reachability incident closes and the revert restores the caps)",
+    ["playbook"], registry=GROUP_REGISTRY)
+_MTTR_BUCKETS = (1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+                 1800.0)
+REMEDIATION_MTTR = Histogram(
+    "remediation_mttr_seconds",
+    "Open-to-close duration of incidents the remediation engine acted "
+    "on — mean time to recovery as a first-class SLI",
+    registry=GROUP_REGISTRY, buckets=_MTTR_BUCKETS)
 
 RELAY_STALE_SERVED = Counter(
     "relay_stale_served_total",

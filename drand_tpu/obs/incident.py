@@ -74,6 +74,10 @@ SHED_MIN = int(os.environ.get("DRAND_TPU_INCIDENT_SHED_MIN", "8"))
 # env-var names matching this are value-redacted in config fingerprints
 _SECRETISH_ENV = re.compile(r"(?i)(secret|_key|token|passw|share|seed)")
 
+# remediation-ledger entries kept per incident (obs/remediate appends
+# via annotate_remediation; oldest dropped past the cap)
+REMEDIATION_LEDGER_MAX = 64
+
 _log = logging.getLogger("drand_tpu.obs.incident")
 
 
@@ -237,6 +241,11 @@ def _incident_counter(rule: str):
     if rule == "shed_surge":
         return metrics.INCIDENTS_TOTAL.labels(rule="shed_surge",
                                               severity="warning")
+    if rule == "worker_down":
+        # minted by obs/remediate.worker_down_rule (supervised-worker
+        # death feeding the respawn playbook)
+        return metrics.INCIDENTS_TOTAL.labels(rule="worker_down",
+                                              severity="major")
     return metrics.INCIDENTS_TOTAL.labels(rule="custom",
                                           severity="warning")
 
@@ -327,6 +336,10 @@ class IncidentManager:
         self._last_sample_t = float("-inf")
         self._persist_warned = False
         self._sample_warned = False
+        # the attached auto-remediation PlaybookEngine (obs/remediate);
+        # None = detection-only (the seed behavior)
+        self.engine = None
+        self._engine_warned = False
 
     # ------------------------------------------------------------ plumbing
     def _flight_obj(self):
@@ -408,9 +421,23 @@ class IncidentManager:
         with self._lock:
             self._period = period
             self._last_sample_t = now
-            dirty = self._evaluate_locked(now, period)
+            dirty, events = self._evaluate_locked(now, period)
+            engine = self.engine
         if dirty:
             self._persist_dirty(dirty)
+        if engine is not None and events:
+            # hand lifecycle events to the remediation engine OUTSIDE
+            # the manager lock (ISSUE 13: playbook dispatch must never
+            # run under it); a broken engine must not break detection
+            try:
+                engine.on_incidents(events, now)
+            except Exception:  # noqa: BLE001
+                with self._lock:
+                    warned = self._engine_warned
+                    self._engine_warned = True
+                if not warned:
+                    _log.warning("remediation engine hand-off failed",
+                                 exc_info=True)
         return sample
 
     def _persist_dirty(self, dirty: list[str]) -> None:
@@ -455,17 +482,20 @@ class IncidentManager:
         return self.on_round(None, now=now, period=p)
 
     # ------------------------------------------------------------- rules
-    def _evaluate_locked(self, now: float,
-                         period: float | None) -> list[str]:
+    def _evaluate_locked(self, now: float, period: float | None,
+                         ) -> tuple[list[str], list[dict]]:
         """Evaluate every rule against the window; mint/extend/close.
-        Returns the incident ids whose disk state is now dirty — the
-        caller persists them OUTSIDE the lock."""
+        Returns (dirty incident ids to persist, lifecycle events for
+        the remediation engine) — both handled by the caller OUTSIDE
+        the lock. Event summaries are copies: the engine reads them on
+        its own schedule."""
         from .. import metrics
 
         window = self.ring.window(self.ring.max_samples)
         if not window:
-            return []
+            return [], []
         dirty: list[str] = []
+        events: list[dict] = []
         for rule in self.rules:
             # ctx carries whether THIS rule already has an open
             # incident, so a trigger can latch on it (readiness_flip)
@@ -481,10 +511,17 @@ class IncidentManager:
                     open_inc["fired"] += 1
                     open_inc["last_seen"] = now
                     open_inc["detail"] = detail
+                    events.append({"event": "extended",
+                                   "summary": dict(open_inc)})
                 elif now >= self._cooldown_until.get(rule.name,
                                                      float("-inf")):
-                    dirty.append(
-                        self._mint_locked(rule, detail, now, window[-1]))
+                    inc_id = self._mint_locked(rule, detail, now,
+                                               window[-1])
+                    dirty.append(inc_id)
+                    events.append({
+                        "event": "minted",
+                        "summary": dict(
+                            self._incidents[inc_id]["summary"])})
             elif open_inc is not None:
                 q = self._quiet.get(rule.name, 0) + 1
                 self._quiet[rule.name] = q
@@ -492,10 +529,12 @@ class IncidentManager:
                     open_inc["state"] = "closed"
                     open_inc["closed_at"] = now
                     dirty.append(open_inc["id"])
+                    events.append({"event": "closed",
+                                   "summary": dict(open_inc)})
                     del self._active[rule.name]
                     self._cooldown_until[rule.name] = now + rule.cooldown_s
         metrics.INCIDENT_ACTIVE.set(len(self._active))
-        return dirty
+        return dirty, events
 
     def _mint_locked(self, rule: Rule, detail: str, now: float,
                      sample: dict) -> str:
@@ -590,6 +629,26 @@ class IncidentManager:
                              "(dir %s); forensics stay in memory only",
                              inc_id, self.dir_path)
 
+    def annotate_remediation(self, inc_id: str, entry: dict) -> bool:
+        """Append one remediation-ledger entry to the incident's
+        summary — and therefore its bundle (the persist/get_bundle
+        lifecycle refresh carries ``summary`` keys into the frozen
+        bundle). THE audit trail the tentpole requires: every attempted
+        action and outcome, in the forensic record, capped so a
+        flapping playbook cannot grow a bundle without bound. Called by
+        the PlaybookEngine's ledger writer (a registered secretflow
+        sink, like the bundle writers). Returns False for unknown or
+        evicted incidents."""
+        with self._lock:
+            rec = self._incidents.get(inc_id)
+            if rec is None:
+                return False
+            ledger = rec["summary"].setdefault("remediation", [])
+            ledger.append(dict(entry))
+            del ledger[:-REMEDIATION_LEDGER_MAX]
+        self._persist_dirty([inc_id])
+        return True
+
     def capture_bundle(self, *, now: float | None = None,
                        reason: str = "manual") -> dict:
         """One-shot MANUAL capture — ``drand-tpu util support-bundle``
@@ -655,6 +714,7 @@ class IncidentManager:
             self._last_sample_t = float("-inf")
             self._persist_warned = False
             self._sample_warned = False
+            self._engine_warned = False
         self.ring.reset()
 
 

@@ -1,0 +1,702 @@
+"""Closed-loop auto-remediation: incidents drive audited playbooks
+(ISSUE 16).
+
+PR 15 made every chaos-proven fault mint an incident; PR 12 built the
+recovery primitives (retry, breakers, quorum repair, multi-upstream
+failover, worker respawn). This module closes the loop: a
+:class:`PlaybookEngine` attached to an ``IncidentManager`` maps each
+anomaly rule to a **remediation playbook**:
+
+==================  ==================  =================================
+rule                playbook            action
+==================  ==================  =================================
+sync_stall          sync_resume         rotate ``Syncer.follow`` to the
+                                        next upstream, resume from the
+                                        checkpoint (``store.last()+1``)
+breaker_open        quorum_pull         targeted ``PartialRequest`` pull
+(persistent)                            + half-open probe per OPEN peer
+reachability_drop   partition_posture   serve stale from cache, lower
+(majority)                              the watcher-shed threshold;
+                                        reverted when the incident closes
+worker_down         respawn_worker      respawn through the bounded
+                                        ``utils.supervise.Supervisor``
+margin_degraded     reshare_recommend   operator-visible reshare
+(repeated, pinned)                      recommendation into the bundle
+==================  ==================  =================================
+
+**Guardrails are the feature**, and every one is observable:
+
+- a global max-actions-per-window budget (live actions only),
+- a per-playbook cooldown (one action per sustained fault, not one per
+  sample),
+- a DEFAULT-ON dry-run mode that only annotates the incident
+  (``DRAND_TPU_REMEDIATE=live`` arms real actions),
+- every attempted action + outcome appended to the incident's forensic
+  bundle as a **remediation ledger** (the audit trail) and to the
+  engine's own bounded ring, surfaced over ``GET /debug/remediation``,
+  ``drand-tpu util remediate`` and the catalogued
+  ``remediation_actions_total{playbook,outcome}`` /
+  ``remediation_active{playbook}`` / ``remediation_mttr_seconds``
+  metrics (MTTR as a first-class SLI).
+
+Concurrency rules (ISSUE 13, enforced by tools/analyze): the manager
+hands events to :meth:`PlaybookEngine.on_incidents` OUTSIDE its lock;
+engine decisions are dict work under the engine's own lock with no
+awaits inside it; actions are dispatched through
+``drand_tpu.utils.aio.spawn`` (or ``run_coroutine_threadsafe`` from the
+store thread) and any retries ride ``drand_tpu.utils.retry``'s
+injectable clock, so the chaos e2e stays deterministic on the
+FakeClock. The ledger writers (:meth:`PlaybookEngine.record_action`,
+``IncidentManager.annotate_remediation``) are registered secretflow
+sinks — key material flowing into a ledger entry fails the static gate
+exactly like logging it would.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..utils.clock import Clock, SystemClock
+
+# playbook names — the remediation_active{playbook} metric enum
+# (tools/check_metrics.py KNOWN_LABEL_VALUES)
+PLAYBOOK_SYNC = "sync_resume"
+PLAYBOOK_PULL = "quorum_pull"
+PLAYBOOK_POSTURE = "partition_posture"
+PLAYBOOK_RESPAWN = "respawn_worker"
+PLAYBOOK_RESHARE = "reshare_recommend"
+
+# ledger outcomes — the remediation_actions_total{outcome} enum
+OUTCOME_OK = "ok"
+OUTCOME_FAILED = "failed"
+OUTCOME_DRY_RUN = "dry_run"
+OUTCOME_BUDGET = "budget_exhausted"
+OUTCOME_REVERTED = "reverted"
+
+# global action budget: at most MAX live actions per WINDOW seconds
+DEFAULT_MAX_ACTIONS = int(os.environ.get("DRAND_TPU_REMEDIATE_MAX", "8"))
+DEFAULT_WINDOW_S = float(
+    os.environ.get("DRAND_TPU_REMEDIATE_WINDOW", "300"))
+LEDGER_MAX = 256
+
+_log = logging.getLogger("drand_tpu.obs.remediate")
+
+
+def _env_dry_run() -> bool:
+    """Dry-run is the DEFAULT: the engine annotates what it WOULD do
+    until the operator explicitly arms it with
+    ``DRAND_TPU_REMEDIATE=live``."""
+    return os.environ.get("DRAND_TPU_REMEDIATE", "dry_run") != "live"
+
+
+def _action_counter(playbook: str, outcome: str):
+    """Branch-literal outcome labels for remediation_actions_total (the
+    check_metrics KNOWN_LABEL_VALUES enum rule — the net_retry pattern:
+    ``playbook`` rides a variable, bounded by the playbook registry).
+    The engine mints only the five outcomes below; anything else is a
+    bug and collapses to ``failed`` rather than forking the series."""
+    from .. import metrics
+
+    if outcome == "ok":
+        return metrics.REMEDIATION_ACTIONS.labels(playbook=playbook,
+                                                  outcome="ok")
+    if outcome == "dry_run":
+        return metrics.REMEDIATION_ACTIONS.labels(playbook=playbook,
+                                                  outcome="dry_run")
+    if outcome == "budget_exhausted":
+        return metrics.REMEDIATION_ACTIONS.labels(
+            playbook=playbook, outcome="budget_exhausted")
+    if outcome == "reverted":
+        return metrics.REMEDIATION_ACTIONS.labels(playbook=playbook,
+                                                  outcome="reverted")
+    return metrics.REMEDIATION_ACTIONS.labels(playbook=playbook,
+                                              outcome="failed")
+
+
+def _active_gauge(playbook: str):
+    """Branch-literal playbook labels for remediation_active (the
+    incidents_total ``_incident_counter`` pattern); operator-defined
+    playbooks collapse to ``custom``."""
+    from .. import metrics
+
+    if playbook == "sync_resume":
+        return metrics.REMEDIATION_ACTIVE.labels(playbook="sync_resume")
+    if playbook == "quorum_pull":
+        return metrics.REMEDIATION_ACTIVE.labels(playbook="quorum_pull")
+    if playbook == "partition_posture":
+        return metrics.REMEDIATION_ACTIVE.labels(
+            playbook="partition_posture")
+    if playbook == "respawn_worker":
+        return metrics.REMEDIATION_ACTIVE.labels(
+            playbook="respawn_worker")
+    if playbook == "reshare_recommend":
+        return metrics.REMEDIATION_ACTIVE.labels(
+            playbook="reshare_recommend")
+    return metrics.REMEDIATION_ACTIVE.labels(playbook="custom")
+
+
+# ---------------------------------------------------------------------------
+# playbooks
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Playbook:
+    """One rule -> action mapping with its own guardrail knobs.
+
+    ``min_fired`` gates on incident persistence (an incident's
+    ``fired`` count — e.g. breaker_open must re-fire before the pull,
+    a one-sample blip self-heals). ``when`` is an extra predicate over
+    (incident summary, engine) — e.g. the MAJORITY check for partition
+    posture. ``sticky`` playbooks stay active (gauge = 1) until the
+    incident closes, at which point the registered revert runs.
+    ``annotate_only`` playbooks never touch system state: their action
+    is a synchronous recommendation builder whose output goes into the
+    ledger even in dry-run mode (a recommendation IS an annotation)."""
+
+    name: str
+    rule: str
+    describe: str
+    cooldown_s: float = 60.0
+    min_fired: int = 1
+    annotate_only: bool = False
+    sticky: bool = False
+    when: Callable[[dict, "PlaybookEngine"], bool] | None = \
+        field(default=None, repr=False)
+
+
+def _majority_unreachable(summary: dict, engine: "PlaybookEngine") -> bool:
+    """Partition posture fires only on a MAJORITY reachability drop:
+    losing one peer is the breaker/pull playbooks' job; losing most of
+    the mesh means this node is the partition minority and should serve
+    degraded rather than hammer dead upstreams."""
+    mgr = engine.manager
+    sample = mgr.ring.last() if mgr is not None else None
+    suspects = int((sample or {}).get("suspects") or 0)
+    n = engine.n_peers
+    if n:
+        return 2 * suspects >= n
+    return suspects >= 2
+
+
+def default_playbooks() -> list[Playbook]:
+    """The built-in rule -> playbook map (README "Auto-remediation"
+    documents each with its guardrails)."""
+    return [
+        Playbook(PLAYBOOK_SYNC, rule="sync_stall",
+                 describe="rotate the follow to the next upstream and "
+                          "resume from the chain checkpoint",
+                 cooldown_s=30.0),
+        Playbook(PLAYBOOK_PULL, rule="breaker_open",
+                 describe="targeted quorum-repair pull plus a half-open "
+                          "probe of each OPEN peer breaker",
+                 cooldown_s=30.0, min_fired=2),
+        Playbook(PLAYBOOK_POSTURE, rule="reachability_drop",
+                 describe="partition posture: serve stale from the "
+                          "cache, lower the watcher-shed threshold",
+                 cooldown_s=60.0, min_fired=2, sticky=True,
+                 when=_majority_unreachable),
+        Playbook(PLAYBOOK_RESPAWN, rule="worker_down",
+                 describe="respawn dead supervised worker(s) through "
+                          "the bounded supervisor",
+                 cooldown_s=10.0),
+        Playbook(PLAYBOOK_RESHARE, rule="margin_degraded",
+                 describe="operator-visible reshare recommendation "
+                          "written into the incident bundle",
+                 cooldown_s=120.0, min_fired=3, annotate_only=True),
+    ]
+
+
+def worker_down_rule(supervisor, *, cooldown_s: float = 30.0):
+    """An incident Rule minting ``worker_down`` while any worker
+    registered with the Supervisor reads dead — the detection half of
+    the respawn playbook (the rule ignores the SLI window; worker
+    liveness is the supervisor's own probe)."""
+    from .incident import Rule
+
+    def _trigger(w: list[dict], ctx: dict) -> str | None:
+        dead = supervisor.dead()
+        if dead:
+            return (f"{len(dead)} supervised worker(s) dead: "
+                    f"{', '.join(dead)}")
+        return None
+
+    return Rule("worker_down", "major", "edge", _trigger,
+                cooldown_s=cooldown_s)
+
+
+def reshare_recommendation(flight, n_rounds: int = 8,
+                           min_ratio: float = 0.5) -> str | None:
+    """The reshare_recommend builder: a peer index whose shares were
+    missing/late/invalid in at least ``min_ratio`` of the recent
+    rounds, with at least twice the degradation of everyone else
+    combined (= the fault is PINNED to one peer, not ambient), earns an
+    operator-visible recommendation. Returns None when nothing is
+    pinned — reshares are a ceremony, never auto-run."""
+    from .flight import BITMAP_INVALID, BITMAP_LATE, BITMAP_MISSING
+
+    counts: dict[int, int] = {}
+    total = 0
+    for rec in flight.rounds(n_rounds):
+        total += 1
+        for idx, ch in enumerate(rec.get("bitmap") or ""):
+            if ch in (BITMAP_MISSING, BITMAP_INVALID, BITMAP_LATE):
+                counts[idx] = counts.get(idx, 0) + 1
+    if total < 3 or not counts:
+        return None
+    worst, bad = max(counts.items(), key=lambda kv: (kv[1], -kv[0]))
+    others = sum(v for k, v in counts.items() if k != worst)
+    if bad < min_ratio * total or bad < 2 * others:
+        return None
+    return (f"reshare recommended: peer index {worst} degraded in "
+            f"{bad}/{total} recent rounds (missing/late/invalid "
+            f"shares) while the rest of the group stayed healthy — "
+            f"consider a reshare ceremony excluding it")
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+class PlaybookEngine:
+    """Guardrailed rule -> playbook dispatch with a full audit trail.
+
+    Attach to an ``IncidentManager`` (:meth:`attach`); the manager
+    hands minted/extended/closed incident events here outside its lock.
+    Action callables are INJECTED per deployment (``attach_node``,
+    ``attach_posture``, ``attach_supervisor`` below) so the daemon, a
+    relay, and the chaos harness each wire exactly the handles they
+    have. Thread-safe: decisions run under ``_lock`` (events arrive
+    from the store thread AND the /healthz poll path), dispatch and
+    ledger writes happen outside it."""
+
+    def __init__(self, *, clock: Clock | None = None,
+                 dry_run: bool | None = None,
+                 max_actions: int | None = None,
+                 window_s: float | None = None,
+                 playbooks: list[Playbook] | None = None,
+                 ledger_max: int = LEDGER_MAX):
+        self._clock = clock or SystemClock()
+        self.dry_run = _env_dry_run() if dry_run is None else dry_run
+        self.max_actions = (DEFAULT_MAX_ACTIONS if max_actions is None
+                            else max_actions)
+        self.window_s = DEFAULT_WINDOW_S if window_s is None else window_s
+        self.ledger_max = ledger_max
+        self.playbooks = (list(playbooks) if playbooks is not None
+                          else default_playbooks())
+        self.n_peers: int | None = None
+        self.supervisor = None
+        self._by_rule: dict[str, list[Playbook]] = {}
+        for pb in self.playbooks:
+            self._by_rule.setdefault(pb.rule, []).append(pb)
+        self._lock = threading.Lock()
+        self._manager = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._actions: dict[str, Callable] = {}
+        self._reverts: dict[str, Callable] = {}
+        self._ledger: deque[dict] = deque(maxlen=ledger_max)
+        self._recent: deque[float] = deque()   # live-dispatch timestamps
+        self._cooldown_until: dict[str, float] = {}
+        self._active: dict[str, str] = {}      # playbook -> incident id
+        self._acted: set[str] = set()          # incident ids acted on
+        self._dispatch_warned = False
+
+    # ------------------------------------------------------------- wiring
+    @property
+    def manager(self):
+        return self._manager
+
+    def attach(self, manager) -> None:
+        """Bind this engine to an IncidentManager (one engine per
+        manager; re-attach replaces)."""
+        with self._lock:
+            self._manager = manager
+        manager.engine = self
+
+    def register_action(self, playbook: str, fn: Callable) -> None:
+        """The playbook's action: ``async (incident_summary) -> detail``
+        (annotate-only playbooks take a SYNC builder)."""
+        with self._lock:
+            self._actions[playbook] = fn
+
+    def register_revert(self, playbook: str, fn: Callable) -> None:
+        """Run when a sticky playbook's incident closes (posture
+        restore). Async like actions."""
+        with self._lock:
+            self._reverts[playbook] = fn
+
+    def arm(self) -> None:
+        """Leave dry-run: actions really fire from here on."""
+        with self._lock:
+            self.dry_run = False
+
+    def disarm(self) -> None:
+        with self._lock:
+            self.dry_run = True
+
+    # ------------------------------------------------------------- intake
+    def on_incidents(self, events: list[dict], now: float) -> None:
+        """The manager's hand-off (called OUTSIDE its lock) — one entry
+        per minted/extended/closed incident this sample."""
+        self._capture_loop()
+        for ev in events:
+            kind = ev.get("event")
+            summary = ev.get("summary") or {}
+            if kind == "closed":
+                self._on_closed(summary)
+                continue
+            if kind not in ("minted", "extended"):
+                continue
+            for pb in self._by_rule.get(summary.get("rule"), ()):
+                self._consider(pb, summary, now)
+
+    def _capture_loop(self) -> None:
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        with self._lock:
+            self._loop = loop
+
+    def _consider(self, pb: Playbook, summary: dict, now: float) -> None:
+        if summary.get("fired", 0) < pb.min_fired:
+            return
+        if pb.when is not None:
+            try:
+                if not pb.when(summary, self):
+                    return
+            except Exception:  # noqa: BLE001 — a broken predicate skips
+                return
+        inc_id = summary.get("id")
+        dispatch = None
+        with self._lock:
+            if now < self._cooldown_until.get(pb.name, float("-inf")):
+                return  # cooldown dedup: one action per sustained fault
+            if pb.name in self._active:
+                return  # an action is already in flight / posture held
+            action = self._actions.get(pb.name)
+            if pb.annotate_only:
+                self._cooldown_until[pb.name] = now + pb.cooldown_s
+                mode = "annotate"
+            elif self.dry_run:
+                self._cooldown_until[pb.name] = now + pb.cooldown_s
+                mode = "dry_run"
+            elif self._budget_left_locked(now) <= 0:
+                self._cooldown_until[pb.name] = now + pb.cooldown_s
+                mode = "budget"
+            else:
+                # live: reserve the budget slot + the active marker
+                # inside the lock, then dispatch outside it
+                self._recent.append(now)
+                self._cooldown_until[pb.name] = now + pb.cooldown_s
+                self._active[pb.name] = inc_id or ""
+                if inc_id:
+                    self._acted.add(inc_id)
+                mode = "live"
+        if mode == "annotate":
+            self._run_annotate(pb, action, summary, now)
+            return
+        if mode == "dry_run":
+            self.record_action(pb.name, OUTCOME_DRY_RUN, incident=inc_id,
+                               mode="dry_run", detail=f"would: {pb.describe}",
+                               t=now)
+            return
+        if mode == "budget":
+            self.record_action(
+                pb.name, OUTCOME_BUDGET, incident=inc_id, mode="live",
+                detail=f"budget exhausted ({self.max_actions} actions/"
+                       f"{self.window_s:g}s); not running: {pb.describe}",
+                t=now)
+            return
+        _active_gauge(pb.name).set(1)
+        if action is None:
+            self._finish(pb, inc_id, OUTCOME_FAILED,
+                         "no action registered for this playbook",
+                         self._clock.now())
+            return
+        if not self._dispatch(self._run_action(pb, action, summary)):
+            self._finish(pb, inc_id, OUTCOME_FAILED,
+                         "no event loop to dispatch the action on",
+                         self._clock.now())
+
+    def _budget_left_locked(self, now: float) -> int:
+        while self._recent and self._recent[0] <= now - self.window_s:
+            self._recent.popleft()
+        return self.max_actions - len(self._recent)
+
+    def _dispatch(self, coro) -> bool:
+        """Fire-and-forget on the event loop: ``aio.spawn`` when the
+        caller is ON the loop; ``run_coroutine_threadsafe`` from the
+        store thread. No loop at all (a pure-sync harness) = the action
+        cannot run."""
+        from ..utils.aio import spawn
+
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            with self._lock:
+                loop = self._loop
+            if loop is not None and not loop.is_closed():
+                asyncio.run_coroutine_threadsafe(coro, loop)
+                return True
+            coro.close()
+            with self._lock:
+                warned = self._dispatch_warned
+                self._dispatch_warned = True
+            if not warned:
+                _log.warning("remediation action dropped: no event loop")
+            return False
+        spawn(coro)
+        return True
+
+    # ------------------------------------------------------------ running
+    async def _run_action(self, pb: Playbook, action: Callable,
+                          summary: dict) -> None:
+        inc_id = summary.get("id")
+        try:
+            detail = await action(dict(summary))
+            outcome, text = OUTCOME_OK, str(detail)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — failures are ledgered
+            outcome = OUTCOME_FAILED
+            text = f"{type(e).__name__}: {e}"
+        self._finish(pb, inc_id, outcome, text, self._clock.now())
+
+    def _finish(self, pb: Playbook, inc_id: str | None, outcome: str,
+                text: str, now: float) -> None:
+        with self._lock:
+            # sticky playbooks that SUCCEEDED hold their active marker
+            # (and gauge) until the incident closes and the revert runs
+            if not (pb.sticky and outcome == OUTCOME_OK):
+                self._active.pop(pb.name, None)
+        if not (pb.sticky and outcome == OUTCOME_OK):
+            _active_gauge(pb.name).set(0)
+        self.record_action(pb.name, outcome, incident=inc_id, mode="live",
+                           detail=text, t=now)
+
+    def _run_annotate(self, pb: Playbook, action: Callable | None,
+                      summary: dict, now: float) -> None:
+        if action is None:
+            return
+        try:
+            text = action(dict(summary))
+        except Exception as e:  # noqa: BLE001
+            self.record_action(pb.name, OUTCOME_FAILED,
+                               incident=summary.get("id"), mode="annotate",
+                               detail=f"{type(e).__name__}: {e}", t=now)
+            return
+        if not text:
+            # nothing pinned yet: don't burn the cooldown — the next
+            # sample re-evaluates with more rounds of evidence
+            with self._lock:
+                self._cooldown_until.pop(pb.name, None)
+            return
+        self.record_action(pb.name, OUTCOME_OK, incident=summary.get("id"),
+                           mode="annotate", detail=str(text), t=now)
+
+    def _on_closed(self, summary: dict) -> None:
+        from .. import metrics
+
+        inc_id = summary.get("id") or ""
+        opened, closed = summary.get("opened_at"), summary.get("closed_at")
+        with self._lock:
+            acted = inc_id in self._acted
+            self._acted.discard(inc_id)
+            reverts = [(pb, self._reverts.get(pb.name))
+                       for pb in self._by_rule.get(summary.get("rule"), ())
+                       if self._active.get(pb.name) == inc_id]
+        if acted and opened is not None and closed is not None:
+            # MTTR as an SLI: open-to-close of incidents we acted on
+            metrics.REMEDIATION_MTTR.observe(max(0.0, closed - opened))
+        for pb, revert in reverts:
+            if revert is None:
+                with self._lock:
+                    self._active.pop(pb.name, None)
+                _active_gauge(pb.name).set(0)
+                continue
+            self._dispatch(self._run_revert(pb, revert, summary))
+
+    async def _run_revert(self, pb: Playbook, revert: Callable,
+                          summary: dict) -> None:
+        inc_id = summary.get("id")
+        try:
+            detail = await revert(dict(summary))
+            outcome, text = OUTCOME_REVERTED, str(detail)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            outcome, text = OUTCOME_FAILED, f"{type(e).__name__}: {e}"
+        with self._lock:
+            self._active.pop(pb.name, None)
+        _active_gauge(pb.name).set(0)
+        self.record_action(pb.name, outcome, incident=inc_id, mode="live",
+                           detail=text, t=self._clock.now())
+
+    # ------------------------------------------------------------- ledger
+    def record_action(self, playbook: str, outcome: str, *,
+                      incident: str | None, mode: str, detail: str,
+                      t: float) -> dict:
+        """THE remediation-ledger writer (a registered secretflow sink,
+        like the bundle writers): one entry per attempted action or
+        refusal, appended to the engine ring AND the incident's bundle,
+        counted on remediation_actions_total."""
+        entry = {"t": round(t, 6), "playbook": playbook,
+                 "incident": incident, "mode": mode, "outcome": outcome,
+                 "detail": detail}
+        with self._lock:
+            self._ledger.append(entry)
+            mgr = self._manager
+        _action_counter(playbook, outcome).inc()
+        if mgr is not None and incident:
+            try:
+                mgr.annotate_remediation(incident, entry)
+            except Exception:  # noqa: BLE001 — the audit trail must not
+                pass           # take the action path down
+        return entry
+
+    # ------------------------------------------------------------ outputs
+    def ledger(self, n: int = 32) -> list[dict]:
+        """The last ``n`` ledger entries, most recent first."""
+        with self._lock:
+            entries = list(self._ledger)[-n:] if n > 0 else []
+        return [dict(e) for e in reversed(entries)]
+
+    def status(self, n: int = 32) -> dict:
+        """The /debug/remediation payload."""
+        with self._lock:
+            now = self._clock.now()
+            used = self.max_actions - self._budget_left_locked(now)
+            active = dict(self._active)
+            cooldowns = {name: round(until - now, 3)
+                         for name, until in self._cooldown_until.items()
+                         if until > now}
+            registered = set(self._actions)
+            mode = "dry_run" if self.dry_run else "live"
+            attached = self._manager is not None
+        return {
+            "mode": mode,
+            "attached": attached,
+            "budget": {"max": self.max_actions,
+                       "window_s": self.window_s, "used": used},
+            "active": active,
+            "cooldowns_s": cooldowns,
+            "playbooks": [{"playbook": pb.name, "rule": pb.rule,
+                           "cooldown_s": pb.cooldown_s,
+                           "min_fired": pb.min_fired,
+                           "annotate_only": pb.annotate_only,
+                           "registered": (pb.name in registered
+                                          or pb.annotate_only),
+                           "describe": pb.describe}
+                          for pb in self.playbooks],
+            "supervisor": (self.supervisor.status()
+                           if self.supervisor is not None else None),
+            "ledger": self.ledger(n),
+        }
+
+    def reset(self) -> None:
+        """Back to boot state (tests/harness isolation) — guardrail
+        counters, ledger and active markers only; attachments and
+        registered actions survive (production wiring must not be
+        unhooked by a scenario reset)."""
+        with self._lock:
+            active = list(self._active)
+            self._ledger.clear()
+            self._recent.clear()
+            self._cooldown_until.clear()
+            self._active.clear()
+            self._acted.clear()
+            self._dispatch_warned = False
+        for name in active:
+            _active_gauge(name).set(0)
+
+
+# ---------------------------------------------------------------------------
+# deployment wiring
+# ---------------------------------------------------------------------------
+
+def attach_node(engine: PlaybookEngine, handler) -> None:
+    """Wire the beacon-node playbooks onto a Handler: sync_resume and
+    quorum_pull act through the PR-12 recovery primitives; the reshare
+    recommendation reads the handler's flight recorder."""
+    engine.n_peers = len(handler.conf.group.nodes) - 1
+
+    async def _sync_resume(summary: dict) -> str:
+        return await handler.remediate_sync()
+
+    async def _quorum_pull(summary: dict) -> str:
+        return await handler.remediate_breakers()
+
+    def _reshare(summary: dict) -> str | None:
+        return reshare_recommendation(handler.flight)
+
+    engine.register_action(PLAYBOOK_SYNC, _sync_resume)
+    engine.register_action(PLAYBOOK_PULL, _quorum_pull)
+    engine.register_action(PLAYBOOK_RESHARE, _reshare)
+
+
+def attach_posture(engine: PlaybookEngine, server) -> None:
+    """Wire partition posture onto a PublicServer: applied on a
+    majority reachability drop, REVERTED when the incident closes."""
+
+    async def _apply(summary: dict) -> str:
+        return server.set_partition_posture(True)
+
+    async def _revert(summary: dict) -> str:
+        return server.set_partition_posture(False)
+
+    engine.register_action(PLAYBOOK_POSTURE, _apply)
+    engine.register_revert(PLAYBOOK_POSTURE, _revert)
+
+
+def attach_supervisor(engine: PlaybookEngine, supervisor) -> None:
+    """Wire the respawn playbook onto a utils.supervise.Supervisor;
+    pair with ``worker_down_rule(supervisor)`` on the manager so death
+    is detected as an incident and respawn rides the engine's budget,
+    cooldown, dry-run and ledger."""
+    engine.supervisor = supervisor
+
+    async def _respawn(summary: dict) -> str:
+        dead = supervisor.dead()
+        if not dead:
+            return "no dead workers"
+        outcomes = [f"{name}={supervisor.maybe_respawn(name)}"
+                    for name in dead]
+        line = ", ".join(outcomes)
+        if not any(o.endswith("=respawned") for o in outcomes):
+            raise RuntimeError(f"respawn blocked: {line}")
+        return line
+
+    engine.register_action(PLAYBOOK_RESPAWN, _respawn)
+
+
+# The per-process engine (the INCIDENTS/FLIGHT singleton pattern).
+# NOT attached to INCIDENTS by default — the daemon/relay attach it via
+# configure_from_env so harnesses with their own managers stay clean.
+ENGINE = PlaybookEngine()
+
+
+def configure_from_env(manager=None) -> PlaybookEngine:
+    """Attach the singleton engine to ``manager`` (default: the
+    INCIDENTS singleton) and (re)load the env knobs:
+    ``DRAND_TPU_REMEDIATE`` (``live`` arms it; anything else = dry-run),
+    ``DRAND_TPU_REMEDIATE_MAX`` / ``DRAND_TPU_REMEDIATE_WINDOW`` for
+    the global action budget."""
+    if manager is None:
+        from .incident import INCIDENTS
+        manager = INCIDENTS
+    with ENGINE._lock:
+        ENGINE.dry_run = _env_dry_run()
+        ENGINE.max_actions = int(
+            os.environ.get("DRAND_TPU_REMEDIATE_MAX",
+                           str(DEFAULT_MAX_ACTIONS)))
+        ENGINE.window_s = float(
+            os.environ.get("DRAND_TPU_REMEDIATE_WINDOW",
+                           str(DEFAULT_WINDOW_S)))
+    ENGINE.attach(manager)
+    return ENGINE

@@ -33,18 +33,21 @@ from contextlib import contextmanager
 
 def reset_observability() -> None:
     """Reset FLIGHT (rounds, peers, reachability, DKG timelines),
-    HEALTH, TRACER and INCIDENTS (time-series ring + incident state)
+    HEALTH, TRACER, INCIDENTS (time-series ring + incident state) and
+    the remediation ENGINE (ledger, budget, cooldowns, active markers)
     to boot state. Safe against concurrent note_* calls — each
     singleton's own reset carries its lock discipline."""
     from .flight import FLIGHT
     from .health import HEALTH
     from .incident import INCIDENTS
+    from .remediate import ENGINE
     from .trace import TRACER
 
     FLIGHT.reset()
     HEALTH.reset()
     TRACER.reset()
     INCIDENTS.reset()
+    ENGINE.reset()
 
 
 @contextmanager

@@ -1,0 +1,156 @@
+"""Bounded-respawn worker supervision (ISSUE 16).
+
+Generalizes the relay parent's sweeper-respawn loop (cli/__main__.py
+``_relay_parent``, ISSUE 14): a registered worker that dies gets
+respawned at most ``respawn_budget`` times, with exponential backoff
+between attempts so a crash-looping worker cannot fork-bomb the box.
+Two consumers share this one policy:
+
+- the SO_REUSEPORT relay parent supervises its designated timelock
+  sweeper worker (a subprocess), and
+- the auto-remediation ``respawn_worker`` playbook
+  (obs/remediate.py) supervises in-process beacon workers through the
+  same budget/backoff, so a respawn decided by an incident rides the
+  identical guardrails an operator-run parent applies.
+
+The supervisor itself never blocks: backoff is expressed as a
+*not-before* time on the injectable clock (FakeClock in chaos tests —
+fully deterministic), and ``maybe_respawn`` returns an outcome string
+instead of sleeping. ``respawn`` callables are synchronous; an async
+restart is wrapped by the caller (``aio.spawn(net.restart(i))``) so
+subprocess parents — which have no event loop at all — and playbook
+actions use the same interface.
+
+Thread-safe: decisions are made under ``_lock`` (the repo's named-lock
+convention); the registered callables run OUTSIDE it — a subprocess
+spawn takes milliseconds and must not stall a concurrent status read.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from .clock import Clock, SystemClock
+
+# outcomes of one maybe_respawn decision
+ALIVE = "alive"
+RESPAWNED = "respawned"
+RESPAWN_FAILED = "respawn_failed"
+BUDGET_EXHAUSTED = "budget_exhausted"
+BACKOFF = "backoff"
+UNKNOWN = "unknown"
+
+
+class Supervisor:
+    """Registered workers + a bounded, backoff-paced respawn policy."""
+
+    def __init__(self, *, clock: Clock | None = None,
+                 respawn_budget: int = 5,
+                 backoff_base_s: float = 0.5,
+                 backoff_cap_s: float = 30.0):
+        self._clock = clock or SystemClock()
+        self.respawn_budget = respawn_budget
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self._lock = threading.Lock()
+        # name -> {"is_alive": fn, "respawn": fn, "respawns": int,
+        #          "not_before": float}
+        self._workers: dict[str, dict] = {}
+
+    # ---------------------------------------------------------- registry
+    def register(self, name: str, *, is_alive: Callable[[], bool],
+                 respawn: Callable[[], object]) -> None:
+        """Register (or replace) one supervised worker. ``is_alive``
+        must be cheap and non-blocking (a ``Popen.poll()``, a set
+        lookup); ``respawn`` starts a replacement synchronously."""
+        with self._lock:
+            self._workers[name] = {"is_alive": is_alive,
+                                   "respawn": respawn,
+                                   "respawns": 0,
+                                   "not_before": float("-inf")}
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._workers.pop(name, None)
+
+    def workers(self) -> list[str]:
+        with self._lock:
+            return sorted(self._workers)
+
+    def respawns(self, name: str) -> int:
+        with self._lock:
+            w = self._workers.get(name)
+            return w["respawns"] if w else 0
+
+    # ------------------------------------------------------------- state
+    def dead(self) -> list[str]:
+        """Registered workers whose ``is_alive`` currently reads False.
+        Probes run outside the lock (they are caller code)."""
+        with self._lock:
+            probes = [(n, w["is_alive"]) for n, w in self._workers.items()]
+        out = []
+        for name, probe in probes:
+            try:
+                alive = bool(probe())
+            except Exception:  # noqa: BLE001 — a broken probe reads dead
+                alive = False
+            if not alive:
+                out.append(name)
+        return sorted(out)
+
+    def status(self) -> dict:
+        """Per-worker supervision state for the debug surfaces."""
+        dead = set(self.dead())
+        with self._lock:
+            return {name: {"alive": name not in dead,
+                           "respawns": w["respawns"],
+                           "budget": self.respawn_budget,
+                           "not_before": (None
+                                          if w["not_before"] == float("-inf")
+                                          else round(w["not_before"], 6))}
+                    for name, w in self._workers.items()}
+
+    # ----------------------------------------------------------- respawn
+    def maybe_respawn(self, name: str, now: float | None = None) -> str:
+        """One supervision decision for ``name``: respawn it if it is
+        dead, the budget is not exhausted, and the backoff window has
+        passed. Never blocks — returns the outcome."""
+        if now is None:
+            now = self._clock.now()
+        with self._lock:
+            w = self._workers.get(name)
+            if w is None:
+                return UNKNOWN
+            probe, respawn = w["is_alive"], w["respawn"]
+        try:
+            alive = bool(probe())
+        except Exception:  # noqa: BLE001
+            alive = False
+        if alive:
+            return ALIVE
+        with self._lock:
+            w = self._workers.get(name)
+            if w is None:
+                return UNKNOWN
+            if w["respawns"] >= self.respawn_budget:
+                return BUDGET_EXHAUSTED
+            if now < w["not_before"]:
+                return BACKOFF
+            # reserve the slot under the lock: a concurrent caller must
+            # not double-spawn the same worker
+            w["respawns"] += 1
+            backoff = min(self.backoff_cap_s,
+                          self.backoff_base_s * (2 ** (w["respawns"] - 1)))
+            w["not_before"] = now + backoff
+        try:
+            respawn()
+        except Exception:  # noqa: BLE001 — the slot stays spent
+            return RESPAWN_FAILED
+        return RESPAWNED
+
+    def check(self, now: float | None = None) -> dict[str, str]:
+        """Sweep every registered worker once; outcomes by name
+        (workers that are alive are included as ``alive``)."""
+        return {name: self.maybe_respawn(name, now=now)
+                for name in self.workers()}

@@ -48,9 +48,22 @@ _BUNDLE_SINKS = {"freeze_bundle", "write_bundle", "capture_bundle",
                  "persist_bundle", "support_bundle", "freeze_locked",
                  "persist_locked"}
 
+# the remediation-ledger writer sink class (obs/remediate.py, ISSUE
+# 16): ledger entries ride the incident bundle (annotate_remediation
+# merges them into the summary the persist path serializes) AND the
+# /debug/remediation payload — the same disk/operator trust boundary
+# as the bundles, so a playbook logging a share fails the gate the
+# same way.
+_LEDGER_SINKS = {"record_action", "annotate_remediation",
+                 "append_ledger", "ledger_entry"}
+
 
 def _is_bundle_sink(name: str | None) -> bool:
     return name is not None and name.lstrip("_") in _BUNDLE_SINKS
+
+
+def _is_ledger_sink(name: str | None) -> bool:
+    return name is not None and name.lstrip("_") in _LEDGER_SINKS
 
 
 def _is_module_alias(name: str, fn: FuncInfo) -> bool:
@@ -179,6 +192,11 @@ def _scan_function(fn: FuncInfo) -> list[Finding]:
                         if names:
                             emit("secret-in-bundle", child.lineno,
                                  names, "a forensic bundle")
+                    elif _is_ledger_sink(func.attr):
+                        names = check_call_args(child)
+                        if names:
+                            emit("secret-in-ledger", child.lineno,
+                                 names, "a remediation ledger")
                 elif isinstance(func, ast.Name):
                     if func.id == "print":
                         names = check_call_args(child)
@@ -190,6 +208,11 @@ def _scan_function(fn: FuncInfo) -> list[Finding]:
                         if names:
                             emit("secret-in-bundle", child.lineno,
                                  names, "a forensic bundle")
+                    elif _is_ledger_sink(func.id):
+                        names = check_call_args(child)
+                        if names:
+                            emit("secret-in-ledger", child.lineno,
+                                 names, "a remediation ledger")
             walk(child)
 
     for stmt in fn.node.body:

@@ -111,8 +111,22 @@ KNOWN_LABEL_VALUES = {
     "incidents_total": {
         "rule": {"missed_round", "readiness_flip", "breaker_open",
                  "reachability_drop", "sync_stall", "margin_degraded",
-                 "ingress_flood", "shed_surge", "custom"},
+                 "ingress_flood", "shed_surge", "worker_down", "custom"},
         "severity": {"critical", "major", "warning"},
+    },
+    # auto-remediation (ISSUE 16): outcomes are branch-literal in
+    # obs/remediate.py _action_counter (the `playbook` label there
+    # rides a variable — bounded by the playbook registry, the
+    # net_retry `op` rule); the active gauge's playbooks ARE
+    # branch-literal (_active_gauge), unknown ones collapse to
+    # playbook="custom"
+    "remediation_actions_total": {
+        "outcome": {"ok", "failed", "dry_run", "budget_exhausted",
+                    "reverted"},
+    },
+    "remediation_active": {
+        "playbook": {"sync_resume", "quorum_pull", "partition_posture",
+                     "respawn_worker", "reshare_recommend", "custom"},
     },
 }
 

@@ -72,6 +72,17 @@
 #                          coexists in one chunk fine; no pair entry
 #                          needed.
 #   test_zz_obs_health.py  chain-health SLO / OTLP export suite
+#   test_zz_remediate.py   auto-remediation plane: playbook-engine
+#                          guardrails, bounded supervisor, ledger-sink
+#                          analyzer fixtures, chaos-oracle e2e matrix,
+#                          /debug/remediation ?n= (host-only,
+#                          structural crypto + FakeClock; ~6 s).
+#                          CONFLICTS evaluation vs test_zz_chaos/
+#                          test_zz_incident: same structural-crypto
+#                          harness, per-test IncidentManager/
+#                          PlaybookEngine instances, the one singleton
+#                          test detaches in its finally — coexists in
+#                          one chunk fine; no pair entry needed.
 #   test_zz_selfheal.py    self-healing plane: retry policy, breakers,
 #                          quorum repair, stale serving (host-only,
 #                          structural crypto; ~5 s)
