@@ -37,9 +37,10 @@ def randomness_from_signature(sig: bytes) -> bytes:
     return hashlib.sha256(sig).digest()
 
 
-@dataclass
+@dataclass(slots=True)
 class Beacon:
-    """One round of the chain (chain/beacon.go:16)."""
+    """One round of the chain (chain/beacon.go:16). Slotted: catch-up
+    walks materialize and field-scan millions of these."""
 
     round: int = 0
     previous_sig: bytes = b""

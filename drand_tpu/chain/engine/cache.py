@@ -25,6 +25,10 @@ class RoundCache:
         self.id = rid
         self.sigs: dict[int, bytes] = {}
         self.sigs_v2: dict[int, bytes] = {}
+        # checkpoint piggyback partials (net/packets.py partial_ckpt):
+        # collected alongside the beacon partials, recovered by the
+        # aggregator when the round is a checkpoint boundary
+        self.sigs_ckpt: dict[int, bytes] = {}
 
     def append(self, p: PartialBeaconPacket) -> bool:
         idx = tbls.index_of(p.partial_sig)
@@ -33,6 +37,8 @@ class RoundCache:
         self.sigs[idx] = p.partial_sig
         if p.partial_sig_v2:
             self.sigs_v2[idx] = p.partial_sig_v2
+        if p.partial_ckpt:
+            self.sigs_ckpt[idx] = p.partial_ckpt
         return True
 
     def __len__(self) -> int:
@@ -40,6 +46,9 @@ class RoundCache:
 
     def len_v2(self) -> int:
         return len(self.sigs_v2)
+
+    def len_ckpt(self) -> int:
+        return len(self.sigs_ckpt)
 
     def msg(self) -> bytes:
         return chain_beacon.message(self.round, self.prev)
@@ -50,9 +59,13 @@ class RoundCache:
     def partials_v2(self) -> list[bytes]:
         return list(self.sigs_v2.values())
 
+    def partials_ckpt(self) -> list[bytes]:
+        return list(self.sigs_ckpt.values())
+
     def flush_index(self, idx: int) -> None:
         self.sigs.pop(idx, None)
         self.sigs_v2.pop(idx, None)
+        self.sigs_ckpt.pop(idx, None)
 
 
 class PartialCache:

@@ -71,6 +71,10 @@ class ChainStore(CallbackStore):
         # the aggregator mutates it from _process_event and the
         # handler's service surface runs on the same loop.
         self.cache = PartialCache()
+        # latest recovered checkpoint (client/checkpoint.py Checkpoint):
+        # loop-thread-only writes from the aggregator, read by the
+        # handler's service surface on the same loop
+        self.latest_checkpoint = None
         self._agg_task: asyncio.Task | None = None
         self.add_callback("chainstore", self._on_stored)
 
@@ -113,7 +117,8 @@ class ChainStore(CallbackStore):
             return []
         return [PartialBeaconPacket(
                     round=rc.round, previous_sig=rc.prev, partial_sig=sig,
-                    partial_sig_v2=rc.sigs_v2.get(idx, b""))
+                    partial_sig_v2=rc.sigs_v2.get(idx, b""),
+                    partial_ckpt=rc.sigs_ckpt.get(idx, b""))
                 for idx, sig in rc.sigs.items() if idx not in exclude]
 
     async def _run_aggregator(self) -> None:
@@ -225,6 +230,11 @@ class ChainStore(CallbackStore):
             self._l.debug("aggregator", "invalid_recovery", err=str(e), round=rc.round)
             return None
         b = Beacon(round=rc.round, previous_sig=rc.prev, signature=final_sig)
+        if rc.len_ckpt() >= thr:
+            # checkpoint piggyback: recover the group attestation of the
+            # head this round chains from. Strictly best-effort — a
+            # failed checkpoint recovery never blocks the beacon
+            await self._recover_checkpoint(rc, thr, n)
         if rc.len_v2() >= thr:
             msg_v2 = chain_beacon.message_v2(rc.round)
             try:
@@ -241,6 +251,35 @@ class ChainStore(CallbackStore):
                 return None  # never accept a beacon whose V2 fails to recover
             b.signature_v2 = sig_v2
         return b
+
+    async def _recover_checkpoint(self, rc, thr: int, n: int) -> None:
+        """Recover the checkpoint signature for round rc.round-1 from the
+        piggybacked partials (client/checkpoint.py): one Lagrange
+        recovery + product check on a worker thread. Any failure is
+        logged and dropped — checkpoints are an accelerator for client
+        bootstrap, never load-bearing for the chain itself."""
+        from ... import metrics
+        from ...client.checkpoint import Checkpoint, checkpoint_message
+
+        ckpt_round = rc.round - 1
+        chain_hash = self._crypto.chain_info.hash()
+        cmsg = checkpoint_message(chain_hash, ckpt_round, rc.prev)
+        pub = self._crypto.get_pub()
+        try:
+            _, ckpt_sig = await asyncio.to_thread(
+                batch.aggregate_round,
+                pub, cmsg, rc.partials_ckpt(), thr, n, prevalidated=True)
+        except ValueError as e:
+            # covers RecoveredSignatureInvalid too
+            self._l.warn("aggregator", "checkpoint_recovery_failed",
+                         err=str(e), round=ckpt_round)
+            return
+        self.latest_checkpoint = Checkpoint(
+            round=ckpt_round, signature=rc.prev, chain_hash=chain_hash,
+            ckpt_sig=ckpt_sig)
+        metrics.CKPT_ISSUED.inc()
+        metrics.CKPT_ROUND.set(ckpt_round)
+        self._l.info("aggregator", "checkpoint_recovered", round=ckpt_round)
 
     def _try_append(self, last: Beacon, new_beacon: Beacon) -> bool:
         if last.round + 1 != new_beacon.round:

@@ -12,6 +12,7 @@ import os
 from dataclasses import dataclass
 from typing import AsyncIterator
 
+from ...client import checkpoint as ckpt_mod
 from ...crypto import tbls
 from ...key.group import Group
 from ...key.keys import Node, Share
@@ -82,12 +83,21 @@ class BeaconConfig:
     # the live round is still below threshold past the margin trigger.
     # Off switches the whole monitor (chaos A/B runs, bench baselines).
     repair: bool = True
+    # checkpoint issuance interval in rounds (client/checkpoint.py):
+    # None = the DRAND_TPU_CKPT_INTERVAL env default; 0 disables —
+    # every interval round the partial broadcast piggybacks a partial
+    # over the checkpoint message for the round it chains from
+    checkpoint_interval: int | None = None
 
 
-def _verify_partial_packet(pub, p: PartialBeaconPacket) -> str | None:
+def _verify_partial_packet(pub, p: PartialBeaconPacket,
+                           ckpt_msg: bytes | None = None) -> str | None:
     """The pairing-heavy half of partial ingress, shaped for
     ``asyncio.to_thread`` (node.go:96-130). Returns the rejection
-    reason, or None when the packet is fully valid."""
+    reason, or None when the packet is fully valid. ``ckpt_msg`` is the
+    checkpoint message the caller expects a piggybacked checkpoint
+    partial to sign (None when p.round-1 is not a checkpoint boundary —
+    an unexpected checkpoint partial is then rejected outright)."""
     msg = chain_beacon.message(p.round, p.previous_sig)
     if not tbls.verify_partial(pub, msg, p.partial_sig):
         return "invalid partial signature"
@@ -102,6 +112,15 @@ def _verify_partial_packet(pub, p: PartialBeaconPacket) -> str | None:
         msg_v2 = chain_beacon.message_v2(p.round)
         if not tbls.verify_partial(pub, msg_v2, p.partial_sig_v2):
             return "invalid partial signature v2"
+    if p.partial_ckpt:
+        if ckpt_msg is None:
+            return "unexpected checkpoint partial"
+        # same-index rule as V2: a checkpoint partial must come from the
+        # share that signed the beacon partial it rides with
+        if tbls.index_of(p.partial_ckpt) != tbls.index_of(p.partial_sig):
+            return "checkpoint partial index mismatch"
+        if not tbls.verify_partial(pub, ckpt_msg, p.partial_ckpt):
+            return "invalid checkpoint partial"
     return None
 
 
@@ -141,6 +160,30 @@ class Handler(ProtocolService):
         self._remediate_policy = RetryPolicy(
             attempts=2, base_s=max(0.05, period / 8),
             cap_s=max(0.1, period / 4))
+        # checkpoint issuance cadence (client/checkpoint.py): every
+        # interval round the partial broadcast attests the head it
+        # chains from; the aggregator recovers the group signature
+        self._ckpt_interval = (conf.checkpoint_interval
+                               if conf.checkpoint_interval is not None
+                               else ckpt_mod.CKPT_INTERVAL)
+
+    def _ckpt_msg_for(self, round_no: int, previous_sig: bytes
+                      ) -> bytes | None:
+        """The checkpoint message a round's partial broadcast piggybacks
+        (None when round_no-1 is not a checkpoint boundary). The
+        attested round is round_no-1 — ``previous_sig`` IS its recovered
+        chain signature."""
+        ckpt_round = round_no - 1
+        if (self._ckpt_interval <= 0 or ckpt_round < 1
+                or ckpt_round % self._ckpt_interval != 0):
+            return None
+        return ckpt_mod.checkpoint_message(
+            self.crypto.chain_info.hash(), ckpt_round, previous_sig)
+
+    def checkpoint(self):
+        """Latest recovered checkpoint (client/checkpoint.py Checkpoint)
+        or None — what GET /checkpoints/latest serves."""
+        return self.chain.latest_checkpoint
 
     # ------------------------------------------------------------------ API
     async def start(self) -> None:
@@ -264,7 +307,8 @@ class Handler(ProtocolService):
             # /healthz and gossip stay serviced (the gRPC gateway calls
             # this once per peer per round, right at the boundary burst)
             err = await asyncio.to_thread(
-                _verify_partial_packet, self.crypto.get_pub(), p)
+                _verify_partial_packet, self.crypto.get_pub(), p,
+                self._ckpt_msg_for(p.round, p.previous_sig))
             if err is not None:
                 self._l.error("process_partial", from_addr, err=err,
                               round=p.round)
@@ -398,11 +442,17 @@ class Handler(ProtocolService):
                 curr_sig = self.crypto.sign_partial(msg)
                 sig_v2 = self.crypto.sign_partial(
                     chain_beacon.message_v2(round_no))
+                # checkpoint piggyback: at interval boundaries also
+                # attest the head this round chains from
+                ckpt_msg = self._ckpt_msg_for(round_no, previous_sig)
+                sig_ckpt = (self.crypto.sign_partial(ckpt_msg)
+                            if ckpt_msg is not None else b"")
                 packet = PartialBeaconPacket(
                     round=round_no,
                     previous_sig=previous_sig,
                     partial_sig=curr_sig,
                     partial_sig_v2=sig_v2,
+                    partial_ckpt=sig_ckpt,
                 )
             self._l.debug("broadcast_partial", round=round_no)
             self._note_flight(packet, "valid", source="self")

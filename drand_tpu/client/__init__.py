@@ -25,6 +25,7 @@ def new_client(
     v1_verification_until: int | None = None,
     cache_size: int = 256,
     insecurely: bool = False,
+    checkpoints: bool = True,
 ) -> Client:
     """Build the verified client stack over one or more sources.
 
@@ -36,6 +37,9 @@ def new_client(
       point (verify.go getTrustedPreviousSignature).
     - ``v1_verification_until``: rounds after this verify via the unchained
       V2 signature (client/client.go:367 WithV1VerificationUntil).
+    - ``checkpoints``: let the strict walk bootstrap head trust from a
+      group-signed checkpoint when the source serves one
+      (client/checkpoint.py; falls back to the full walk on any doubt).
     """
     if not sources:
         raise ValueError("need at least one source")
@@ -48,7 +52,8 @@ def new_client(
     wrapped: list[Client] = [
         VerifyingClient(_pinned(s, chain_info, chain_hash),
                         strict_rounds=strict_rounds,
-                        v1_until=v1_verification_until)
+                        v1_until=v1_verification_until,
+                        use_checkpoints=checkpoints)
         for s in sources
     ]
     inner = wrapped[0] if len(wrapped) == 1 else OptimizingClient(wrapped)
@@ -98,6 +103,22 @@ class _PinnedClient(Client):
 
     async def close(self) -> None:
         await self._src.close()
+
+    def __getattr__(self, name: str):
+        # OPTIONAL source capabilities (get_span bulk fetch,
+        # get_checkpoint) pass through the pin transparently — but only
+        # when the wrapped source actually has them, so feature probes
+        # via getattr(src, ..., None) see the truth. The trust root
+        # still gates every forwarded call.
+        if name in ("get_span", "get_checkpoint"):
+            inner = getattr(self._src, name)  # AttributeError when absent
+
+            async def forward(*args, **kwargs):
+                await self.info()
+                return await inner(*args, **kwargs)
+
+            return forward
+        raise AttributeError(name)
 
 
 __all__ = [

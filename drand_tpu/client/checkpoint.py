@@ -1,0 +1,103 @@
+"""Checkpointed trust: group-signed chain-head attestations.
+
+A checkpoint binds ``(chain_hash, round, signature)`` under the group
+key: the threshold of nodes that recovered round ``round`` also
+threshold-signs a domain-separated checkpoint message over the round's
+recovered signature, and a fresh strict client that verifies ONE
+checkpoint signature (one product check) holds exactly the trust a full
+catch-up walk to ``round`` would have produced — under the same
+honest-threshold assumption both rest on (see README "Client
+verification economics" for the soundness argument).
+
+Domain separation lives in the MESSAGE, not the DST, so checkpoint
+partials ride the existing tbls machinery (sign_partial /
+verify_partial / aggregate_round) unchanged: beacon V1 preimages are
+``prev_sig(96B) || round(8B)``, V2 preimages ``round(8B)``, checkpoint
+preimages ``TAG(23B) || chain_hash(32B) || round(8B) || sig(96B)`` —
+three pairwise-distinct input lengths, so no cross-family sha256 input
+can collide and the group never signs one digest meaning two things.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from dataclasses import dataclass
+
+from ..chain.beacon import round_to_bytes
+from ..crypto import tbls
+
+# the checkpoint message tag — 23 bytes, making the checkpoint preimage
+# length distinct from both beacon preimage families (see module doc)
+CKPT_TAG = b"drand-tpu/checkpoint/v1"
+
+# daemon: issue a checkpoint every this-many rounds (0 disables).
+# Cost per interval round: one extra partial sign per node and one
+# extra Lagrange recovery + product check on the aggregator.
+CKPT_INTERVAL = int(os.environ.get("DRAND_TPU_CKPT_INTERVAL", "32"))
+
+# client: how many random skipped-history rounds a checkpoint bootstrap
+# spot-checks (one batched RLC product check for the whole sample;
+# 0 = trust the checkpoint alone)
+SPOT_CHECKS = int(os.environ.get("DRAND_TPU_CKPT_SPOT_CHECKS", "8"))
+
+
+def checkpoint_message(chain_hash: bytes, round_no: int,
+                       signature: bytes) -> bytes:
+    """The digest the group threshold-signs for a checkpoint."""
+    h = hashlib.sha256()
+    h.update(CKPT_TAG)
+    h.update(chain_hash)
+    h.update(round_to_bytes(round_no))
+    h.update(signature)
+    return h.digest()
+
+
+@dataclass(frozen=True)
+class Checkpoint:
+    """A group-signed chain-head attestation.
+
+    ``signature`` is round ``round``'s recovered beacon signature (the
+    trust point a walk would end on); ``ckpt_sig`` is the group BLS
+    signature over :func:`checkpoint_message`.
+    """
+
+    round: int
+    signature: bytes
+    chain_hash: bytes
+    ckpt_sig: bytes
+
+
+def verify_checkpoint(pubkey, chain_hash: bytes, ckpt: Checkpoint) -> bool:
+    """Client-side acceptance: the checkpoint must name OUR chain and
+    carry a valid group signature over its canonical message. False on
+    any mismatch — checkpoints arrive from untrusted relays."""
+    if ckpt.round < 1 or ckpt.chain_hash != chain_hash:
+        return False
+    if not ckpt.signature or not ckpt.ckpt_sig:
+        return False
+    msg = checkpoint_message(chain_hash, ckpt.round, ckpt.signature)
+    return tbls.verify_recovered(pubkey, msg, ckpt.ckpt_sig)
+
+
+def checkpoint_json(c: Checkpoint) -> dict:
+    return {
+        "round": c.round,
+        "signature": c.signature.hex(),
+        "chain_hash": c.chain_hash.hex(),
+        "checkpoint_sig": c.ckpt_sig.hex(),
+    }
+
+
+def checkpoint_from_json(d: dict) -> Checkpoint:
+    from .interface import ClientError
+
+    try:
+        return Checkpoint(
+            round=int(d["round"]),
+            signature=bytes.fromhex(d["signature"]),
+            chain_hash=bytes.fromhex(d["chain_hash"]),
+            ckpt_sig=bytes.fromhex(d["checkpoint_sig"]),
+        )
+    except (KeyError, ValueError, TypeError) as e:
+        raise ClientError(f"malformed checkpoint JSON: {e!r}") from e

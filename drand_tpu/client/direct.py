@@ -30,6 +30,26 @@ class DirectClient(Client):
             raise ClientError("chain has no rounds yet")
         return result_from_beacon(b)
 
+    async def get_span(self, lo: int, hi: int) -> list:
+        """Bulk catch-up fast path: the verifying client's chunk fetch
+        reads ``[lo, hi)`` in one call instead of hi-lo round trips."""
+        store = self._h.chain
+        out = []
+        for rn in range(lo, hi):
+            b = store.get(rn)
+            if b is None:
+                raise ClientError(f"round {rn} not in chain")
+            out.append(b)
+        return out
+
+    async def get_checkpoint(self):
+        """Latest group-signed checkpoint the node's aggregator
+        recovered (client/checkpoint.py Checkpoint)."""
+        c = self._h.checkpoint()
+        if c is None:
+            raise ClientError("no checkpoint recovered yet")
+        return c
+
     async def watch(self):
         q: asyncio.Queue = asyncio.Queue(maxsize=32)
         cb_id = f"client-watch-{id(q)}"

@@ -77,6 +77,14 @@ class HTTPClient(Client):
         path = "/public/latest" if round_no == 0 else f"/public/{round_no}"
         return result_from_json(await self._get_json(path))
 
+    async def get_checkpoint(self):
+        """Latest group-signed checkpoint the node serves — the strict
+        client's O(1) trust bootstrap (client/checkpoint.py)."""
+        from .checkpoint import checkpoint_from_json
+
+        return checkpoint_from_json(
+            await self._get_json("/checkpoints/latest"))
+
     async def watch(self):
         """Poll for each upcoming round (client/http/poll.go:13): sleep to
         the next round boundary, then long-poll GET it."""

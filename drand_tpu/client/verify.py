@@ -4,8 +4,23 @@ Reference: client/verify.go — verify (:176) with the V1/V2 switchover
 (WithV1VerificationUntil, client/client.go:367-377) and the trusted-
 previous-signature catch-up walk (:115, loop :146-163). The catch-up walk
 is THE bulk-verify hot path BASELINE.json names: here it runs as batched
-multi-pairing chunks through crypto.batch (device engine when active)
-instead of one sequential pairing pair per historical round.
+RLC chunks through crypto.batch (one product check per chunk; corruption
+anywhere is caught by the fresh-scalar bisection inside
+crypto/batch_verify, bit-identical to per-item verdicts) with
+
+- ADAPTIVE chunks: start at ``CATCHUP_CHUNK``, double while chunks
+  verify clean up to ``CATCHUP_CHUNK_MAX``, halve on failure — a year of
+  a 3 s chain costs thousands of product checks, not millions of
+  pairings;
+- PIPELINED fetch/verify: chunk k+1 prefetches while chunk k verifies on
+  its worker thread, so the walk is bounded by max(fetch, verify), not
+  their sum;
+- a bounded TRUST RING of verified ``(round, signature)`` points, so an
+  old-round re-fetch resumes from the nearest prior trust point instead
+  of re-walking from genesis;
+- optional CHECKPOINT bootstrap (client/checkpoint.py): a fresh client
+  verifies one group-signed head attestation plus a spot-check sample
+  instead of walking the whole chain.
 """
 
 from __future__ import annotations
@@ -13,17 +28,29 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import os
+import random
 
 from ..chain import beacon as chain_beacon
 from ..chain.beacon import Beacon
 from ..crypto import batch
+from ..net.transport import TransportError
 from ..utils.logging import KVLogger, default_logger
+from . import checkpoint as ckpt_mod
 from .interface import Client, ClientError, Result
 
-# rounds per batched verification chunk during catch-up
+# rounds per batched verification chunk during catch-up (the adaptive
+# walk's FLOOR and starting size)
 CATCHUP_CHUNK = int(os.environ.get("DRAND_TPU_CATCHUP_CHUNK", "64"))
-# concurrent fetches while filling a chunk
+# adaptive growth ceiling: chunks double while they verify clean, up to
+# this many rounds per RLC product check
+CATCHUP_CHUNK_MAX = max(CATCHUP_CHUNK, int(os.environ.get(
+    "DRAND_TPU_CATCHUP_CHUNK_MAX", str(64 * 1024))))
+# concurrent fetches while filling a chunk (per-round fallback path —
+# sources exposing ``get_span`` fetch a whole chunk in one call)
 FETCH_CONCURRENCY = 16
+# bounded count of verified (round, signature) trust points kept for
+# old-round re-fetch resume
+TRUST_RING = 64
 
 
 class VerifyingClient(Client):
@@ -32,6 +59,7 @@ class VerifyingClient(Client):
 
     def __init__(self, source: Client, strict_rounds: bool = False,
                  v1_until: int | None = None,
+                 use_checkpoints: bool = True,
                  logger: KVLogger | None = None):
         self._src = source
         self._strict = strict_rounds
@@ -39,9 +67,17 @@ class VerifyingClient(Client):
         # rounds via the unchained V2 one. None = V1 forever (upstream
         # behavior); 0 = V2 from round 1.
         self._v1_until = v1_until
+        self._use_ckpt = use_checkpoints and os.environ.get(
+            "DRAND_TPU_CKPT_BOOTSTRAP", "1") != "0"
         self._l = logger or default_logger("client.verify")
         # point of trust: (round, signature) with round 0 = genesis
         self._trust: tuple[int, bytes] | None = None
+        # bounded insertion-ordered ring of verified (round, signature)
+        # points — chunk tails and verified heads — so get(old_round)
+        # resumes from the nearest prior point instead of genesis
+        self._ring: dict[int, bytes] = {}
+        # adaptive chunk size, persisted across walks on this client
+        self._chunk = CATCHUP_CHUNK
         self._lock = asyncio.Lock()
 
     # ------------------------------------------------------------- Client
@@ -52,10 +88,18 @@ class VerifyingClient(Client):
     async def watch(self):
         async for r in self._src.watch():
             try:
-                yield await self._verified(r)
-            except ClientError as e:
+                res = await self._verified(r)
+            except asyncio.CancelledError:
+                raise
+            except (ClientError, TransportError, OSError) as e:
+                # a bad beacon OR a transport failure during the strict
+                # catch-up walk drops THIS round and keeps the stream
+                # alive — killing the generator over one flaky fetch
+                # would silently end every downstream watcher
                 self._l.warn("verify", "dropping_beacon", round=r.round,
                              err=str(e))
+                continue
+            yield res
 
     async def info(self):
         return await self._src.info()
@@ -96,8 +140,7 @@ class VerifyingClient(Client):
             raise ClientError(f"round {r.round}: invalid signature")
         if self._strict:
             async with self._lock:
-                if self._trust is None or r.round > self._trust[0]:
-                    self._trust = (r.round, r.signature)
+                self._record_trust(r.round, r.signature)
         return self._finish(r)
 
     @staticmethod
@@ -113,48 +156,197 @@ class VerifyingClient(Client):
         r.randomness = hashlib.sha256(r.signature).digest()
         return r
 
+    # ------------------------------------------------------- trust points
+    def _record_trust(self, round_no: int, sig: bytes) -> None:
+        """Record a verified point (caller holds the lock): the ring for
+        re-fetch resume, ``_trust`` as the monotone head."""
+        if self._trust is None or round_no > self._trust[0]:
+            self._trust = (round_no, sig)
+        if round_no in self._ring:
+            return
+        self._ring[round_no] = sig
+        if len(self._ring) > TRUST_RING:
+            # FIFO: evict the oldest-recorded point (never the genesis —
+            # round 0 is implicit, not stored)
+            self._ring.pop(next(iter(self._ring)))
+
+    def _best_trust(self, round_no: int, info) -> tuple[int, bytes]:
+        """Nearest verified point at or below round_no - 1 (caller holds
+        the lock); genesis when nothing closer is known."""
+        best_round, best_sig = 0, info.genesis_seed
+        t = self._trust
+        if t is not None and t[0] <= round_no - 1 and t[0] > best_round:
+            best_round, best_sig = t
+        for rn, sig in self._ring.items():
+            if best_round < rn <= round_no - 1:
+                best_round, best_sig = rn, sig
+        return best_round, best_sig
+
+    # ---------------------------------------------------------- catch-up
     async def _trusted_previous_signature(self, info, round_no: int) -> bytes:
         """Walk trust forward to round_no-1 (verify.go:115): fetch the gap
-        rounds and verify them in batched multi-pairing chunks."""
+        rounds and verify them in adaptive batched RLC chunks, pipelining
+        the next chunk's fetch under the current chunk's verification."""
+        from .. import metrics
+
         async with self._lock:
-            trust_round, trust_sig = self._trust or (0, info.genesis_seed)
-            if round_no <= trust_round:
-                # re-fetch of an old round: walk from genesis (we only keep
-                # one point of trust, like the reference's trustRound logic)
-                trust_round, trust_sig = 0, info.genesis_seed
+            trust_round, trust_sig = self._best_trust(round_no, info)
+            if trust_round == round_no - 1:
+                # re-fetch of an already-walked round: the ring holds its
+                # predecessor — zero span verifications
+                return trust_sig
+            trust_round, trust_sig = await self._maybe_bootstrap(
+                info, round_no, trust_round, trust_sig)
             start = trust_round + 1
             if start >= round_no:
                 return trust_sig
             self._l.info("verify", "catchup", from_round=start,
-                         to_round=round_no - 1)
-            for lo in range(start, round_no, CATCHUP_CHUNK):
-                hi = min(lo + CATCHUP_CHUNK, round_no)
-                beacons = await self._fetch_span(lo, hi)
-                # linkage first (cheap), then one batched verification
-                prev = trust_sig
-                for b in beacons:
-                    if b.previous_sig != prev:
+                         to_round=round_no - 1, chunk=self._chunk)
+            chunk = self._chunk
+            prev = trust_sig
+            lo = start
+            pending: tuple[asyncio.Task, int, int] | None = None
+            pending = self._spawn_fetch(lo, min(lo + chunk, round_no))
+            try:
+                while lo < round_no:
+                    task, flo, fhi = pending
+                    pending = None
+                    try:
+                        beacons = await task
+                    except BaseException:
+                        # fetch failure: shrink before propagating — the
+                        # next attempt re-probes with a smaller span
+                        self._chunk = max(CATCHUP_CHUNK, chunk // 2)
+                        raise
+                    # optimistic prefetch of the NEXT chunk at the grown
+                    # size while THIS chunk verifies on a worker thread;
+                    # if this chunk fails, the finally-cancel reaps it
+                    grown = min(chunk * 2, CATCHUP_CHUNK_MAX)
+                    if fhi < round_no:
+                        pending = self._spawn_fetch(
+                            fhi, min(fhi + grown, round_no))
+                    # linkage first (cheap), then one batched RLC check;
+                    # the clean-path scan is one C-level pass — walks
+                    # touch millions of rounds, so the per-beacon Python
+                    # loop runs only when a break needs naming
+                    if beacons[0].previous_sig != prev or any(
+                            a.signature != b.previous_sig
+                            for a, b in zip(beacons, beacons[1:])):
+                        self._chunk = max(CATCHUP_CHUNK, chunk // 2)
+                        for b in beacons:
+                            if b.previous_sig != prev:
+                                raise ClientError(
+                                    f"round {b.round}: broken signature "
+                                    f"chain")
+                            prev = b.signature
+                    prev = beacons[-1].signature
+                    # the chunk's product check runs off the loop —
+                    # catch-up walks can be millions of rounds long
+                    oks = await asyncio.to_thread(
+                        batch.verify_beacons, info.public_key, beacons)
+                    if not oks.all():
+                        # the RLC bisection already resolved per-item
+                        # verdicts; name the first bad round and shrink
+                        bad = beacons[int((~oks).argmax())]
+                        self._chunk = max(CATCHUP_CHUNK, chunk // 2)
                         raise ClientError(
-                            f"round {b.round}: broken signature chain")
-                    prev = b.signature
-                # the chunk's multi-pairing span runs off the loop —
-                # catch-up walks can be thousands of rounds long
-                oks = await asyncio.to_thread(
-                    batch.verify_beacons, info.public_key, beacons)
-                if not oks.all():
-                    bad = beacons[int((~oks).argmax())]
-                    raise ClientError(
-                        f"round {bad.round}: invalid signature in history")
-                trust_round, trust_sig = beacons[-1].round, beacons[-1].signature
-                # persist trust PER CHUNK (never regressing): if the walk is
-                # cancelled mid-way (the optimizing client's per-request
-                # timeout wraps the whole get), the next attempt resumes
-                # from the last verified chunk instead of genesis
-                if self._trust is None or trust_round > self._trust[0]:
-                    self._trust = (trust_round, trust_sig)
-            return trust_sig
+                            f"round {bad.round}: invalid signature in "
+                            f"history")
+                    # persist trust PER CHUNK (never regressing): if the
+                    # walk is cancelled mid-way (the optimizing client's
+                    # per-request timeout wraps the whole get), the next
+                    # attempt resumes from the last verified chunk
+                    self._record_trust(beacons[-1].round,
+                                       beacons[-1].signature)
+                    metrics.CLIENT_CATCHUP_ROUNDS.inc(len(beacons))
+                    chunk = grown
+                    self._chunk = chunk
+                    metrics.CLIENT_CATCHUP_CHUNK.set(chunk)
+                    lo = fhi
+            finally:
+                if pending is not None:
+                    task, _, _ = pending
+                    task.cancel()
+                    await asyncio.gather(task, return_exceptions=True)
+            return prev
+
+    async def _maybe_bootstrap(self, info, round_no: int, trust_round: int,
+                               trust_sig: bytes) -> tuple[int, bytes]:
+        """Checkpoint bootstrap (caller holds the lock): when the gap is
+        long and the source serves checkpoints, verify ONE group-signed
+        head attestation (one product check) plus a spot-check sample of
+        the skipped history (one batched product check) instead of
+        walking it. Any failure falls back to the full walk — the
+        checkpoint path can only ever SKIP work, never accept less."""
+        from .. import metrics
+
+        gap = round_no - 1 - trust_round
+        if not self._use_ckpt or gap <= 2 * CATCHUP_CHUNK:
+            return trust_round, trust_sig
+        fetch = getattr(self._src, "get_checkpoint", None)
+        if fetch is None:
+            return trust_round, trust_sig
+        try:
+            ckpt = await fetch()
+        except (ClientError, TransportError, OSError) as e:
+            self._l.debug("verify", "checkpoint_unavailable", err=str(e))
+            return trust_round, trust_sig
+        if ckpt is None or not (trust_round < ckpt.round < round_no):
+            return trust_round, trust_sig
+        chain_hash = info.hash()
+        ok = await asyncio.to_thread(
+            ckpt_mod.verify_checkpoint, info.public_key, chain_hash, ckpt)
+        if not ok:
+            metrics.CKPT_BOOTSTRAPS.labels(result="rejected").inc()
+            self._l.warn("verify", "checkpoint_rejected", round=ckpt.round)
+            return trust_round, trust_sig
+        # spot-check a random sample of the skipped history as ONE RLC
+        # batch: each sampled beacon's signature must bind (round, prev)
+        # under the group key
+        k = min(ckpt_mod.SPOT_CHECKS, max(0, ckpt.round - 1 - trust_round))
+        if k > 0:
+            rounds = sorted(random.sample(
+                range(trust_round + 1, ckpt.round), k))
+            beacons = await self._fetch_rounds(rounds)
+            oks = await asyncio.to_thread(
+                batch.verify_beacons, info.public_key, beacons)
+            if not oks.all():
+                bad = beacons[int((~oks).argmax())]
+                raise ClientError(
+                    f"round {bad.round}: invalid signature in history "
+                    f"(checkpoint spot-check)")
+        metrics.CKPT_BOOTSTRAPS.labels(result="ok").inc()
+        self._l.info("verify", "checkpoint_bootstrap", round=ckpt.round,
+                     skipped=ckpt.round - trust_round, spot_checks=k)
+        self._record_trust(ckpt.round, ckpt.signature)
+        return ckpt.round, ckpt.signature
+
+    # ------------------------------------------------------------ fetching
+    def _spawn_fetch(self, lo: int, hi: int) -> tuple[asyncio.Task, int, int]:
+        return (asyncio.ensure_future(self._fetch_span(lo, hi)), lo, hi)
 
     async def _fetch_span(self, lo: int, hi: int) -> list[Beacon]:
+        span = getattr(self._src, "get_span", None)
+        if span is not None:
+            # bulk fast path: one source call per chunk (DirectClient
+            # reads the store; a range-serving HTTP source maps here)
+            beacons = list(await span(lo, hi))
+            if len(beacons) != hi - lo:
+                raise ClientError(
+                    f"source returned {len(beacons)} rounds for span "
+                    f"[{lo}, {hi})")
+            for rn, b in zip(range(lo, hi), beacons):
+                if b.round != rn:
+                    raise ClientError(
+                        f"source returned round {b.round} for {rn}")
+            return beacons
+        return await self._fetch_rounds(range(lo, hi))
+
+    async def _fetch_rounds(self, rounds) -> list[Beacon]:
+        """Concurrent bounded per-round fetch, cancellation-safe: the
+        first failure cancels AND awaits every sibling before it
+        propagates, so no semaphore-queued fetch keeps running against
+        the source after the caller saw the error."""
         sem = asyncio.Semaphore(FETCH_CONCURRENCY)
 
         async def fetch(rn: int) -> Beacon:
@@ -166,5 +358,11 @@ class VerifyingClient(Client):
                           signature=r.signature,
                           signature_v2=r.signature_v2)
 
-        return list(await asyncio.gather(*(fetch(rn)
-                                           for rn in range(lo, hi))))
+        tasks = [asyncio.ensure_future(fetch(rn)) for rn in rounds]
+        try:
+            return list(await asyncio.gather(*tasks))
+        except BaseException:
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            raise

@@ -10,6 +10,8 @@ existing drand consumers can point at this server unchanged:
     GET /info            -> {"public_key","period","genesis_time",
                              "group_hash","hash"}
     GET /health          -> 200 {"current","expected"} | 500 when lagging
+    GET /checkpoints/latest -> {"round","signature","chain_hash",
+                             "checkpoint_sig"} | 404 before the first one
 
 Serving stack: aiohttp over any client.Client (typically a DirectClient on
 the local daemon, or a verifying client over remote nodes — the reference
@@ -132,6 +134,7 @@ class PublicServer:
             web.get("/public/latest", self._handle_latest),
             web.get("/public/{round}", self._handle_round),
             web.get("/info", self._handle_info),
+            web.get("/checkpoints/latest", self._handle_checkpoint),
             web.get("/health", self._handle_health),
             web.get("/healthz", self._handle_healthz),
             web.get("/readyz", self._handle_readyz),
@@ -517,6 +520,28 @@ class PublicServer:
             "group_hash": info.group_hash.hex(),
             "hash": info.hash().hex(),
         })
+
+    async def _handle_checkpoint(self, request: web.Request) -> web.Response:
+        """Latest signed checkpoint (ISSUE 17): the O(1) trust-bootstrap
+        anchor for catching-up VerifyingClients. 404 while no checkpoint
+        has been recovered yet or the backing client has no checkpoint
+        surface (e.g. a relay over a plain HTTP upstream without one)."""
+        from ..client.checkpoint import checkpoint_json
+
+        get_ckpt = getattr(self._client, "get_checkpoint", None)
+        if get_ckpt is None:
+            return web.json_response(
+                {"error": "checkpoints not available"}, status=404)
+        try:
+            ckpt = await get_ckpt()
+        except ClientError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        resp = web.json_response(checkpoint_json(ckpt))
+        # a checkpoint is immutable once issued, but "latest" moves every
+        # interval — revalidate like /public/latest
+        resp.headers["ETag"] = f'"ckpt-{ckpt.round}"'
+        resp.headers["Cache-Control"] = "no-cache"
+        return resp
 
     async def _handle_health(self, request: web.Request) -> web.Response:
         """Current vs expected round (http/server.go:351)."""

@@ -161,6 +161,24 @@ chain store behind it):
   chain_store_reads_total{backend}     [group]   beacon reads served by
       the chain store by backend (sqlite | segment) — the migration
       observability for the packed segment format
+Million-client catch-up (client/verify.py + client/checkpoint.py,
+ISSUE 17 — RLC span verification, pipelined fetch/verify and signed
+checkpoint trust):
+  client_catchup_rounds_total          [client]  rounds verified by the
+      VerifyingClient catch-up walk (every beacon that passed an RLC
+      span check or per-item fallback)
+  client_catchup_chunk_rounds          [client]  current adaptive
+      catch-up chunk size — grows geometrically toward
+      DRAND_TPU_CATCHUP_CHUNK_MAX while spans verify clean, halves on
+      a corrupt span
+  checkpoint_bootstraps_total{result}  [client]  checkpoint trust
+      bootstraps by result (ok = verified + spot-checked, trust jumped
+      to the checkpoint round; rejected = the signed checkpoint failed
+      verification and the client fell back to the full walk)
+  checkpoint_issued_total              [group]   checkpoints recovered
+      by the aggregator from piggybacked threshold partials
+  checkpoint_round                     [group]   round of the latest
+      recovered checkpoint served at /checkpoints/latest
 Engine introspection (ISSUE 6):
   engine_compile_seconds{op}           [private] FIRST dispatch of each
       (op, path, batch-bucket) device shape — the jit compile +
@@ -497,6 +515,34 @@ CHAIN_STORE_READS = Counter(
     "Beacon reads served by the chain store, by backend "
     "(sqlite|segment) — get() and cursor batches both count per beacon",
     ["backend"], registry=GROUP_REGISTRY)
+
+# ---- million-client catch-up (client/verify.py, ISSUE 17) -----------------
+CLIENT_CATCHUP_ROUNDS = Counter(
+    "client_catchup_rounds_total",
+    "Rounds verified by the VerifyingClient catch-up walk (RLC span "
+    "checks plus per-item fallbacks both count per beacon)",
+    registry=CLIENT_REGISTRY)
+CLIENT_CATCHUP_CHUNK = Gauge(
+    "client_catchup_chunk_rounds",
+    "Current adaptive catch-up chunk size — grows geometrically while "
+    "spans verify clean, halves when a span contains a corrupt beacon",
+    registry=CLIENT_REGISTRY)
+CKPT_BOOTSTRAPS = Counter(
+    "checkpoint_bootstraps_total",
+    "Checkpoint trust bootstraps by result (ok = the signed checkpoint "
+    "verified and the spot-check sample passed, head trust jumped in "
+    "O(1); rejected = verification failed, fell back to the full walk)",
+    ["result"], registry=CLIENT_REGISTRY)
+CKPT_ISSUED = Counter(
+    "checkpoint_issued_total",
+    "Checkpoints recovered by the aggregator from piggybacked "
+    "threshold partials at checkpoint-interval rounds",
+    registry=GROUP_REGISTRY)
+CKPT_ROUND = Gauge(
+    "checkpoint_round",
+    "Round of the latest recovered checkpoint served at "
+    "/checkpoints/latest (0 until the first recovery)",
+    registry=GROUP_REGISTRY)
 
 # ---- OTLP export (obs/export.py) ------------------------------------------
 OTLP_EXPORT_ROUNDS = Counter(

@@ -188,7 +188,8 @@ class GrpcGateway:
             await self._svc.process_partial_beacon(peer, PartialBeaconPacket(
                 round=req["round"], previous_sig=req["previous_sig"],
                 partial_sig=req["partial_sig"],
-                partial_sig_v2=req["partial_sig_v2"]))
+                partial_sig_v2=req["partial_sig_v2"],
+                partial_ckpt=req["partial_ckpt"]))
             return b""  # drand.Empty
         if name == "GetIdentity":
             if request:

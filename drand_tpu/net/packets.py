@@ -22,6 +22,13 @@ class PartialBeaconPacket:
     previous_sig: bytes
     partial_sig: bytes      # 2B index || 96B G2 sig over Message(round, prev)
     partial_sig_v2: bytes   # 2B index || 96B G2 sig over MessageV2(round)
+    # checkpoint piggyback (client/checkpoint.py): when round-1 is a
+    # checkpoint-interval round, a partial over
+    # CheckpointMessage(chain_hash, round-1, previous_sig) — previous_sig
+    # IS round-1's recovered signature, so the broadcast that announces
+    # round R also threshold-attests the head it chains from. Empty
+    # otherwise (wire-compatible with pre-checkpoint peers).
+    partial_ckpt: bytes = b""
 
 
 @dataclass(frozen=True)

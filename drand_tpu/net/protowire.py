@@ -315,6 +315,9 @@ PARTIAL_BEACON_PACKET = {
     2: ("previous_sig", "bytes"),
     3: ("partial_sig", "bytes"),
     4: ("partial_sig_v2", "bytes"),
+    # checkpoint piggyback partial (net/packets.py partial_ckpt) —
+    # proto3-optional: absent on pre-checkpoint peers, decodes to b""
+    5: ("partial_ckpt", "bytes"),
 }
 SIGNAL_DKG_PACKET = {
     1: ("node", ("msg", IDENTITY)),

@@ -72,10 +72,12 @@ def _codec(name):
 
 _codec("partial_beacon")((
     lambda p: {"round": p.round, "prev": _hex(p.previous_sig),
-               "sig": _hex(p.partial_sig), "sig_v2": _hex(p.partial_sig_v2)},
+               "sig": _hex(p.partial_sig), "sig_v2": _hex(p.partial_sig_v2),
+               "sig_ckpt": _hex(p.partial_ckpt)},
     lambda d: PartialBeaconPacket(
         round=int(d["round"]), previous_sig=_unhex(d["prev"]),
-        partial_sig=_unhex(d["sig"]), partial_sig_v2=_unhex(d["sig_v2"]))))
+        partial_sig=_unhex(d["sig"]), partial_sig_v2=_unhex(d["sig_v2"]),
+        partial_ckpt=_unhex(d.get("sig_ckpt", "")))))
 
 _codec("sync_request")((
     lambda r: {"from_round": r.from_round},

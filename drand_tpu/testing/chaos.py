@@ -61,6 +61,7 @@ import numpy as np
 
 from ..chain import beacon as chain_beacon
 from ..chain import time_math
+from ..client import checkpoint as ckpt_mod
 from ..chain.engine import crypto as engine_crypto
 from ..chain.engine import handler as handler_mod
 from ..chain.engine.handler import BeaconConfig, Handler
@@ -79,17 +80,17 @@ from .harness import make_test_group
 # structural (fast) crypto
 # ---------------------------------------------------------------------------
 
-_SIG_HALF = 48  # two blake2b-48 digests = the 96-byte G2 wire size
+_SIG_HALF = 48  # half the 96-byte compressed-G2 wire size
 
 
 def _h96(tag: bytes, msg: bytes) -> bytes:
-    """96 bytes of keyed blake2b — the structural stand-in for a
-    compressed G2 signature (same wire size, same determinism)."""
-    a = hashlib.blake2b(msg, digest_size=_SIG_HALF, key=tag[:64],
-                        person=b"chaos-sim-a").digest()
-    b = hashlib.blake2b(msg, digest_size=_SIG_HALF, key=tag[:64],
-                        person=b"chaos-sim-b").digest()
-    return a + b
+    """96 bytes of shake-256 — the structural stand-in for a
+    compressed G2 signature (same wire size, same determinism). One
+    XOF call instead of two fixed-size digests: million-round
+    structural chains hash on the bench/test critical path. The tag is
+    length-prefixed so tag/message boundaries can't collide."""
+    return hashlib.shake_256(
+        len(tag).to_bytes(1, "big") + tag + msg).digest(96)
 
 
 def group_sig(msg: bytes) -> bytes:
@@ -109,7 +110,8 @@ def make_partial(msg: bytes, index: int) -> bytes:
     return index.to_bytes(tbls.INDEX_BYTES, "big") + partial_body(msg, index)
 
 
-def _structural_verify_packet(pub, p: PartialBeaconPacket) -> str | None:
+def _structural_verify_packet(pub, p: PartialBeaconPacket,
+                              ckpt_msg: bytes | None = None) -> str | None:
     """Drop-in for chain.engine.handler._verify_partial_packet — same
     rejection strings, structural checks."""
     msg = chain_beacon.message(p.round, p.previous_sig)
@@ -126,6 +128,15 @@ def _structural_verify_packet(pub, p: PartialBeaconPacket) -> str | None:
         if p.partial_sig_v2[tbls.INDEX_BYTES:] != partial_body(
                 msg_v2, tbls.index_of(p.partial_sig_v2)):
             return "invalid partial signature v2"
+    if p.partial_ckpt:
+        if ckpt_msg is None:
+            return "unexpected checkpoint partial"
+        if tbls.index_of(p.partial_ckpt) != tbls.index_of(p.partial_sig):
+            return "checkpoint partial index mismatch"
+        if (len(p.partial_ckpt) != tbls.PARTIAL_SIG_SIZE
+                or p.partial_ckpt[tbls.INDEX_BYTES:] != partial_body(
+                    ckpt_msg, tbls.index_of(p.partial_ckpt))):
+            return "invalid checkpoint partial"
     return None
 
 
@@ -158,14 +169,27 @@ def _structural_verify_beacon_v2(pubkey, b) -> bool:
     return b.signature_v2 == group_sig(chain_beacon.message_v2(b.round))
 
 
+# group_sig's shake-256 input prefix for the inlined hot loop below —
+# must stay byte-identical to _h96(b"chaos-group", ...)
+_GROUP_PRE = len(b"chaos-group").to_bytes(1, "big") + b"chaos-group"
+assert hashlib.shake_256(_GROUP_PRE + b"x").digest(96) == _h96(
+    b"chaos-group", b"x")
+
+
 def _structural_verify_beacons(pubkey, beacons, dst: bytes = b""):
-    out = []
-    for b in beacons:
-        ok = _structural_verify_beacon(pubkey, b)
-        if ok and b.is_v2():
-            ok = _structural_verify_beacon_v2(pubkey, b)
-        out.append(ok)
-    return np.asarray(out, dtype=bool)
+    # hot loop: million-round catch-up walks verify through this
+    # stand-in — group_sig(message(...)) is inlined (see the guard
+    # above) to shed four Python call layers per beacon
+    shake, sha, pre = hashlib.shake_256, hashlib.sha256, _GROUP_PRE
+    gs = group_sig
+    return np.fromiter(
+        (b.signature == shake(
+            pre + sha(b.previous_sig
+                      + b.round.to_bytes(8, "big")).digest()).digest(96)
+         and (not b.signature_v2
+              or b.signature_v2 == gs(chain_beacon.message_v2(b.round)))
+         for b in beacons),
+        dtype=bool, count=len(beacons))
 
 
 @contextmanager
@@ -179,16 +203,27 @@ def structural_crypto():
             idx = self._share.pri_share.index
         return make_partial(msg, idx)
 
+    def _structural_verify_checkpoint(pubkey, chain_hash, ckpt) -> bool:
+        # mirrors client/checkpoint.py verify_checkpoint: same sanity
+        # rejections, group-digest check instead of a BLS pairing
+        if (ckpt.round < 1 or ckpt.chain_hash != chain_hash
+                or not ckpt.signature or not ckpt.ckpt_sig):
+            return False
+        return ckpt.ckpt_sig == group_sig(ckpt_mod.checkpoint_message(
+            ckpt.chain_hash, ckpt.round, ckpt.signature))
+
     saved = (engine_crypto.CryptoStore.sign_partial,
              handler_mod._verify_partial_packet,
              batch.aggregate_round, batch.verify_beacons,
-             chain_beacon.verify_beacon, chain_beacon.verify_beacon_v2)
+             chain_beacon.verify_beacon, chain_beacon.verify_beacon_v2,
+             ckpt_mod.verify_checkpoint)
     engine_crypto.CryptoStore.sign_partial = _sign_partial
     handler_mod._verify_partial_packet = _structural_verify_packet
     batch.aggregate_round = _structural_aggregate_round
     batch.verify_beacons = _structural_verify_beacons
     chain_beacon.verify_beacon = _structural_verify_beacon
     chain_beacon.verify_beacon_v2 = _structural_verify_beacon_v2
+    ckpt_mod.verify_checkpoint = _structural_verify_checkpoint
     try:
         yield
     finally:
@@ -196,7 +231,8 @@ def structural_crypto():
          handler_mod._verify_partial_packet,
          batch.aggregate_round, batch.verify_beacons,
          chain_beacon.verify_beacon,
-         chain_beacon.verify_beacon_v2) = saved
+         chain_beacon.verify_beacon_v2,
+         ckpt_mod.verify_checkpoint) = saved
 
 
 # ---------------------------------------------------------------------------

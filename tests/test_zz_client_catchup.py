@@ -1,0 +1,535 @@
+"""Million-client catch-up (ISSUE 17): adaptive RLC span walk,
+pipelined fetch/verify with cancel-resume trust, the bounded trust
+ring, checkpointed bootstrap (daemon recovery + HTTP surface + client
+acceptance and forgery rejection), and the cancellation-safe fetch
+helper.
+
+Late-alphabet filename per the tier-1 chunking convention. Structural
+crypto covers the walk-machinery scenarios; the checkpoint forgery
+matrix and the product-check accounting run real pairings on small
+chains. Everything is host-only (the autouse fixture pins the batch
+dispatch, so no device graphs and no fresh XLA compiles).
+"""
+
+import asyncio
+import dataclasses
+
+import aiohttp
+import pytest
+from conftest import sample_count as _sample_count
+
+from drand_tpu import metrics
+from drand_tpu.chain.beacon import Beacon, message, verify_beacon
+from drand_tpu.chain.info import Info
+from drand_tpu.client import checkpoint as ckpt_mod
+from drand_tpu.client import verify as verify_mod
+from drand_tpu.client.direct import DirectClient
+from drand_tpu.client.interface import ClientError, result_from_beacon
+from drand_tpu.client.verify import VerifyingClient
+from drand_tpu.crypto import batch, bls
+from drand_tpu.crypto import pairing as hpairing
+from drand_tpu.crypto.curves import PointG1
+from drand_tpu.http_server.server import PublicServer
+from drand_tpu.net.packets import PartialBeaconPacket
+from drand_tpu.net.transport import TransportError
+from drand_tpu.testing.chaos import (ChaosBeaconNetwork, group_sig,
+                                     structural_crypto)
+
+GENESIS = b"\x42" * 32
+
+
+@pytest.fixture(autouse=True)
+def _host_crypto():
+    """Pin the dispatch to host crypto: a stray verify_beacons must not
+    kick the jax backend probe mid-test (minute-scale cold compile)."""
+    saved = batch._MODE
+    batch.configure("host")
+    yield
+    batch.configure(saved)
+
+
+def build_chain(n, genesis=GENESIS):
+    """Structural chain: sig_r = group_sig(message(r, prev))."""
+    prev, out = genesis, []
+    for r in range(1, n + 1):
+        sig = group_sig(message(r, prev))
+        out.append(Beacon(round=r, previous_sig=prev, signature=sig))
+        prev = sig
+    return out
+
+
+def structural_info():
+    return Info(public_key=PointG1.generator(), period=3, genesis_time=0,
+                genesis_seed=GENESIS)
+
+
+class ChainSource:
+    """In-memory source over a beacon list. ``span``/``checkpoint``
+    toggle the optional surfaces the client probes via getattr."""
+
+    def __init__(self, beacons, info, checkpoint=None, span=True):
+        self._b = beacons
+        self._info = info
+        self._ckpt = checkpoint
+        if not span:
+            self.get_span = None
+        if checkpoint is None:
+            self.get_checkpoint = None
+
+    async def info(self):
+        return self._info
+
+    async def get(self, rn=0):
+        rn = rn or len(self._b)
+        if not 1 <= rn <= len(self._b):
+            raise ClientError(f"round {rn} not in chain")
+        return result_from_beacon(self._b[rn - 1])
+
+    async def get_span(self, lo, hi):
+        return self._b[lo - 1:hi - 1]
+
+    async def get_checkpoint(self):
+        return self._ckpt
+
+
+def corrupt(beacons, bad_round):
+    """One corrupt signature with SELF-CONSISTENT onward linkage (a
+    forging source would serve exactly this), so only the signature
+    check — not the cheap linkage scan — can catch it."""
+    out = list(beacons)
+    bad_sig = bytes(96)
+    out[bad_round - 1] = dataclasses.replace(out[bad_round - 1],
+                                             signature=bad_sig)
+    if bad_round < len(out):
+        out[bad_round] = dataclasses.replace(out[bad_round],
+                                             previous_sig=bad_sig)
+    return out
+
+
+def counting_verify():
+    """Wrap the CURRENT batch.verify_beacons (structural or host) with
+    a span-verification counter; returns (counter_dict, restore_fn)."""
+    orig = batch.verify_beacons
+    n = {"calls": 0}
+
+    def wrapped(pub, beacons, dst=b""):
+        n["calls"] += 1
+        return orig(pub, beacons)
+
+    batch.verify_beacons = wrapped
+    return n, lambda: setattr(batch, "verify_beacons", orig)
+
+
+# ---------------------------------------------------------------------------
+# adaptive chunks + corruption bisection
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_adaptive_chunk_grows_then_shrinks_on_corruption():
+    with structural_crypto():
+        info = structural_info()
+        chain = build_chain(600)
+        vc = VerifyingClient(ChainSource(chain, info), strict_rounds=True,
+                            use_checkpoints=False)
+        await vc.get(600)
+        grown = vc._chunk
+        assert grown > verify_mod.CATCHUP_CHUNK  # doubled while clean
+
+        # corruption at round 400 lands in the third (256-round) chunk:
+        # the bisection names the exact round and the chunk halves
+        bad = VerifyingClient(ChainSource(corrupt(chain, 400), info),
+                              strict_rounds=True, use_checkpoints=False)
+        with pytest.raises(ClientError, match="round 400: invalid"):
+            await bad.get(600)
+        assert verify_mod.CATCHUP_CHUNK <= bad._chunk < 256
+
+
+@pytest.mark.asyncio
+async def test_broken_linkage_names_round():
+    with structural_crypto():
+        info = structural_info()
+        chain = build_chain(96)
+        # linkage break WITHOUT self-consistent onward prev: the cheap
+        # scan catches it before any span verification
+        chain[40] = dataclasses.replace(chain[40], previous_sig=b"\x13" * 96)
+        vc = VerifyingClient(ChainSource(chain, info), strict_rounds=True,
+                            use_checkpoints=False)
+        with pytest.raises(ClientError, match="round 41: broken signature"):
+            await vc.get(96)
+
+
+# ---------------------------------------------------------------------------
+# trust ring: old-round re-fetch without re-walking
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_trust_ring_zero_span_verifications_on_refetch():
+    with structural_crypto():
+        info = structural_info()
+        chain = build_chain(300)
+        vc = VerifyingClient(ChainSource(chain, info), strict_rounds=True,
+                            use_checkpoints=False)
+        await vc.get(300)  # long walk: ring holds the chunk tails
+        assert vc._trust[0] == 300
+
+        counter, restore = counting_verify()
+        try:
+            # round 65's predecessor (64) is a chunk tail in the ring:
+            # the re-fetch must not re-verify ANY span
+            r = await vc.get(65)
+            assert r.round == 65
+            assert counter["calls"] == 0
+            # a round just past a ring point resumes from it, not
+            # genesis: one span of exactly the small gap
+            await vc.get(70)
+            assert counter["calls"] == 1
+        finally:
+            restore()
+
+
+# ---------------------------------------------------------------------------
+# pipeline: cancel mid-walk persists per-chunk trust, resume skips it
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_cancel_mid_walk_resumes_from_verified_chunk():
+    with structural_crypto():
+        info = structural_info()
+        chain = build_chain(256)
+        gate = asyncio.Event()
+        fetched_los = []
+
+        class GatedSource(ChainSource):
+            async def get_span(self, lo, hi):
+                fetched_los.append(lo)
+                if lo > 64:
+                    await gate.wait()
+                return await super().get_span(lo, hi)
+
+        vc = VerifyingClient(GatedSource(chain, info), strict_rounds=True,
+                            use_checkpoints=False)
+        task = asyncio.ensure_future(vc.get(256))
+        # first chunk [1,65) verifies; the pipelined prefetch of the
+        # second chunk blocks on the gate
+        for _ in range(200):
+            await asyncio.sleep(0.01)
+            if vc._trust is not None and vc._trust[0] >= 64:
+                break
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+        assert vc._trust[0] == 64  # per-chunk persistence survived cancel
+        assert chain[63].signature == vc._trust[1]
+
+        # resume: the walk starts from the persisted trust point, never
+        # re-fetching the verified prefix
+        gate.set()
+        fetched_los.clear()
+        r = await vc.get(256)
+        assert r.round == 256
+        assert fetched_los and min(fetched_los) == 65
+
+
+# ---------------------------------------------------------------------------
+# cancellation-safe per-round fetch (the _fetch_span task-leak fix)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_fetch_rounds_cancels_siblings_on_error():
+    with structural_crypto():
+        info = structural_info()
+        chain = build_chain(64)
+        state = {"in_flight": 0, "max_in_flight": 0, "started": 0}
+
+        class FailingSource(ChainSource):
+            async def get(self, rn=0):
+                if rn == 5:
+                    raise TransportError("boom")
+                state["in_flight"] += 1
+                state["started"] += 1
+                state["max_in_flight"] = max(state["max_in_flight"],
+                                             state["in_flight"])
+                try:
+                    await asyncio.sleep(0.2)  # slow enough to be caught
+                    return await super().get(rn)
+                finally:
+                    state["in_flight"] -= 1
+
+        src = FailingSource(chain, info, span=False)
+        vc = VerifyingClient(src, strict_rounds=True, use_checkpoints=False)
+        with pytest.raises(TransportError):
+            await vc.get(64)
+        # the failure cancelled AND awaited every sibling before
+        # propagating: nothing is still running against the source
+        assert state["in_flight"] == 0
+        started = state["started"]
+        await asyncio.sleep(0.05)
+        assert state["started"] == started  # no stragglers started later
+
+
+# ---------------------------------------------------------------------------
+# watch(): transport errors drop the round, not the stream
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_watch_survives_transport_error():
+    with structural_crypto():
+        info = structural_info()
+        chain = build_chain(3)
+        info_calls = {"n": 0}
+
+        class FlakySource(ChainSource):
+            async def info(self):
+                info_calls["n"] += 1
+                if info_calls["n"] == 2:  # mid-watch, exactly once
+                    raise TransportError("transient relay failure")
+                return self._info
+
+            async def watch(self):
+                for b in self._b:
+                    yield result_from_beacon(b)
+
+        vc = VerifyingClient(FlakySource(chain, info), strict_rounds=False,
+                            use_checkpoints=False)
+        got = [r.round async for r in vc.watch()]
+        assert got == [1, 3]  # round 2 dropped, generator survived
+
+
+# ---------------------------------------------------------------------------
+# get_span validation: a lying bulk source cannot slip rounds through
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_get_span_length_and_round_validation():
+    with structural_crypto():
+        info = structural_info()
+        chain = build_chain(32)
+
+        class ShortSource(ChainSource):
+            async def get_span(self, lo, hi):
+                return self._b[lo - 1:hi - 2]  # one beacon short
+
+        class ShiftedSource(ChainSource):
+            async def get_span(self, lo, hi):
+                return self._b[lo:hi]  # off-by-one round numbers
+
+        vc = VerifyingClient(ShortSource(chain, info), strict_rounds=True,
+                            use_checkpoints=False)
+        with pytest.raises(ClientError, match="rounds for span"):
+            await vc.get(32)
+        vc2 = VerifyingClient(ShiftedSource(chain, info), strict_rounds=True,
+                             use_checkpoints=False)
+        with pytest.raises(ClientError, match="returned round"):
+            await vc2.get(32)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint bootstrap: acceptance, fallback, forgery rejection
+# ---------------------------------------------------------------------------
+
+def make_structural_checkpoint(info, chain, round_no):
+    sig = chain[round_no - 1].signature
+    return ckpt_mod.Checkpoint(
+        round=round_no, signature=sig, chain_hash=info.hash(),
+        ckpt_sig=group_sig(ckpt_mod.checkpoint_message(
+            info.hash(), round_no, sig)))
+
+
+@pytest.mark.asyncio
+async def test_checkpoint_bootstrap_skips_walk():
+    with structural_crypto():
+        info = structural_info()
+        chain = build_chain(2000)
+        ckpt = make_structural_checkpoint(info, chain, 1990)
+        ok0 = _sample_count(metrics.CLIENT_REGISTRY,
+                            "checkpoint_bootstraps", result="ok")
+
+        counter, restore = counting_verify()
+        try:
+            vc = VerifyingClient(ChainSource(chain, info, checkpoint=ckpt),
+                                 strict_rounds=True)
+            r = await vc.get(2000)
+            boot_calls = counter["calls"]
+            counter["calls"] = 0
+            full = VerifyingClient(ChainSource(chain, info),
+                                   strict_rounds=True, use_checkpoints=False)
+            await full.get(2000)
+            walk_calls = counter["calls"]
+        finally:
+            restore()
+        assert r.round == 2000 and vc._trust[0] == 2000
+        # O(1): one spot-check batch + the [1991, 2000) tail span — the
+        # full walk's span count scales with the chain instead
+        assert boot_calls <= 2 < walk_calls
+        assert _sample_count(metrics.CLIENT_REGISTRY,
+                             "checkpoint_bootstraps",
+                             result="ok") == ok0 + 1
+
+
+@pytest.mark.asyncio
+async def test_forged_checkpoint_falls_back_to_full_walk():
+    with structural_crypto():
+        info = structural_info()
+        chain = build_chain(400)
+        good = make_structural_checkpoint(info, chain, 390)
+        forged = dataclasses.replace(good, ckpt_sig=b"\x66" * 96)
+        rej0 = _sample_count(metrics.CLIENT_REGISTRY,
+                             "checkpoint_bootstraps", result="rejected")
+        vc = VerifyingClient(ChainSource(chain, info, checkpoint=forged),
+                             strict_rounds=True)
+        r = await vc.get(400)  # rejected checkpoint NEVER blocks the walk
+        assert r.round == 400
+        assert _sample_count(metrics.CLIENT_REGISTRY,
+                             "checkpoint_bootstraps",
+                             result="rejected") == rej0 + 1
+
+
+@pytest.mark.asyncio
+async def test_checkpoint_spot_check_catches_corrupt_history():
+    with structural_crypto():
+        info = structural_info()
+        chain = build_chain(400)
+        ckpt = make_structural_checkpoint(info, chain, 390)
+        # every skipped round is corrupt (self-consistent linkage), so
+        # ANY spot-check sample must trip; a valid checkpoint over a
+        # corrupt prefix cannot silently launder history
+        bad = list(chain)
+        for rn in range(2, 389):
+            bad = corrupt(bad, rn)
+        vc = VerifyingClient(ChainSource(bad, info, checkpoint=ckpt),
+                             strict_rounds=True)
+        with pytest.raises(ClientError, match="checkpoint spot-check"):
+            await vc.get(400)
+
+
+def test_checkpoint_forgery_matrix_real_crypto():
+    """Wrong key, wrong chain hash, tampered round: each forged
+    checkpoint is rejected by the real pairing check."""
+    sk, pub = bls.keygen(seed=b"ckpt-forgery-test")
+    sk2, _pub2 = bls.keygen(seed=b"ckpt-forgery-other")
+    info = Info(public_key=pub, period=3, genesis_time=0,
+                genesis_seed=GENESIS)
+    chain_hash = info.hash()
+    sig = b"\x17" * 96  # the attested head signature (opaque here)
+    good = ckpt_mod.Checkpoint(
+        round=40, signature=sig, chain_hash=chain_hash,
+        ckpt_sig=bls.sign(sk, ckpt_mod.checkpoint_message(
+            chain_hash, 40, sig)))
+    assert ckpt_mod.verify_checkpoint(pub, chain_hash, good)
+
+    wrong_key = dataclasses.replace(good, ckpt_sig=bls.sign(
+        sk2, ckpt_mod.checkpoint_message(chain_hash, 40, sig)))
+    assert not ckpt_mod.verify_checkpoint(pub, chain_hash, wrong_key)
+
+    other_hash = b"\x99" * 32
+    wrong_chain = ckpt_mod.Checkpoint(
+        round=40, signature=sig, chain_hash=other_hash,
+        ckpt_sig=bls.sign(sk, ckpt_mod.checkpoint_message(
+            other_hash, 40, sig)))
+    assert not ckpt_mod.verify_checkpoint(pub, chain_hash, wrong_chain)
+
+    tampered_round = dataclasses.replace(good, round=41)
+    assert not ckpt_mod.verify_checkpoint(pub, chain_hash, tampered_round)
+
+    # malformed-JSON surface of the same trust boundary
+    with pytest.raises(ClientError, match="malformed checkpoint"):
+        ckpt_mod.checkpoint_from_json({"round": "x"})
+    assert ckpt_mod.checkpoint_from_json(
+        ckpt_mod.checkpoint_json(good)) == good
+
+
+@pytest.mark.asyncio
+async def test_real_bootstrap_constant_product_checks(monkeypatch):
+    """N_PRODUCT_CHECKS accounting on a real-crypto chain: the
+    checkpoint bootstrap spends a CONSTANT number of product checks
+    (checkpoint + spot-check batch + tail span + head), below the full
+    walk's chain-scaled span count. The structural test above and the
+    client_catchup bench assert the asymptotic separation."""
+    monkeypatch.setattr(verify_mod, "CATCHUP_CHUNK", 4)
+    monkeypatch.setattr(ckpt_mod, "SPOT_CHECKS", 4)
+    sk, pub = bls.keygen(seed=b"ckpt-bootstrap-test")
+    info = Info(public_key=pub, period=3, genesis_time=0,
+                genesis_seed=GENESIS)
+    prev, chain = GENESIS, []
+    for rnd in range(1, 41):
+        sig = bls.sign(sk, message(rnd, prev))
+        chain.append(Beacon(round=rnd, previous_sig=prev, signature=sig))
+        prev = sig
+    ckpt = ckpt_mod.Checkpoint(
+        round=36, signature=chain[35].signature, chain_hash=info.hash(),
+        ckpt_sig=bls.sign(sk, ckpt_mod.checkpoint_message(
+            info.hash(), 36, chain[35].signature)))
+
+    c0 = hpairing.N_PRODUCT_CHECKS
+    vc = VerifyingClient(ChainSource(chain, info, checkpoint=ckpt),
+                         strict_rounds=True)
+    assert (await vc.get(40)).round == 40
+    boot_checks = hpairing.N_PRODUCT_CHECKS - c0
+
+    c0 = hpairing.N_PRODUCT_CHECKS
+    full = VerifyingClient(ChainSource(chain, info), strict_rounds=True,
+                           use_checkpoints=False)
+    assert (await full.get(40)).round == 40
+    walk_checks = hpairing.N_PRODUCT_CHECKS - c0
+    assert boot_checks <= 4 < walk_checks
+
+
+# ---------------------------------------------------------------------------
+# daemon recovery + HTTP surface + wire plumbing
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_daemon_recovers_checkpoint_and_serves_it():
+    with structural_crypto():
+        net = ChaosBeaconNetwork(n=3, t=2, period=4)
+        for h in net.handlers:
+            h._ckpt_interval = 2
+        await net.start_all()
+        await net.advance_to_genesis()
+        server = PublicServer(DirectClient(net.handlers[0]),
+                              clock=net.clocks[0])
+        site = await server.start("127.0.0.1", 0)
+        port = site._server.sockets[0].getsockname()[1]
+        url = f"http://127.0.0.1:{port}/checkpoints/latest"
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(url) as resp:
+                    assert resp.status == 404  # nothing recovered yet
+                for _ in range(4):
+                    await net.advance_round()
+                ckpt = net.handlers[0].checkpoint()
+                assert ckpt is not None and ckpt.round % 2 == 0
+                async with sess.get(url) as resp:
+                    assert resp.status == 200
+                    body = await resp.json()
+            got = ckpt_mod.checkpoint_from_json(body)
+            assert got == ckpt
+            info = net.handlers[0].crypto.chain_info
+            assert ckpt_mod.verify_checkpoint(info.public_key, info.hash(),
+                                              got)
+            # the issued-checkpoint telemetry moved with the recovery
+            assert metrics.CKPT_ROUND._value.get() == ckpt.round
+        finally:
+            await server.stop()
+            net.stop_all()
+
+
+def test_partial_ckpt_wire_roundtrip():
+    from drand_tpu.net import protowire, wire
+
+    p = PartialBeaconPacket(round=9, previous_sig=b"\x01" * 96,
+                            partial_sig=b"\x02" * 98, partial_sig_v2=b"",
+                            partial_ckpt=b"\x03" * 98)
+    obj, _addr = wire.decode(wire.encode(p, from_addr="a.test:1"))
+    assert obj == p
+    raw = protowire.encode(protowire.PARTIAL_BEACON_PACKET,
+                           dataclasses.asdict(p))
+    back = protowire.decode(protowire.PARTIAL_BEACON_PACKET, raw)
+    assert back["partial_ckpt"] == p.partial_ckpt
+
+    # decode fills the default for packets from pre-checkpoint peers
+    old = PartialBeaconPacket(round=9, previous_sig=b"\x01" * 96,
+                              partial_sig=b"\x02" * 98, partial_sig_v2=b"")
+    raw_old = protowire.encode(protowire.PARTIAL_BEACON_PACKET,
+                               dataclasses.asdict(old))
+    assert protowire.decode(protowire.PARTIAL_BEACON_PACKET,
+                            raw_old)["partial_ckpt"] == b""

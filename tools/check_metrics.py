@@ -128,6 +128,11 @@ KNOWN_LABEL_VALUES = {
         "playbook": {"sync_resume", "quorum_pull", "partition_posture",
                      "respawn_worker", "reshare_recommend", "custom"},
     },
+    # million-client catch-up (ISSUE 17): checkpoint bootstrap results
+    # are branch-literal in client/verify.py _maybe_bootstrap (ok after
+    # the spot-check passes, rejected when the signed checkpoint fails
+    # verification and the client falls back to the full walk)
+    "checkpoint_bootstraps_total": {"result": {"ok", "rejected"}},
 }
 
 

@@ -83,6 +83,20 @@
 #                          PlaybookEngine instances, the one singleton
 #                          test detaches in its finally — coexists in
 #                          one chunk fine; no pair entry needed.
+#   test_zz_client_catchup.py  million-client catch-up tier: adaptive
+#                          RLC span walk, pipelined fetch/verify
+#                          cancel-resume, trust ring, checkpoint
+#                          bootstrap/forgery matrix, /checkpoints/
+#                          latest route (host-only; structural crypto
+#                          plus ~45 real signatures on 40-round
+#                          chains, batch dispatch pinned to host by
+#                          an autouse fixture; ~6 s). CONFLICTS
+#                          evaluation vs test_zz_chaos/
+#                          test_zz_incident: same structural-crypto
+#                          patch pattern with per-test client/network
+#                          instances, no wall-clock timers, no DKG/
+#                          reshare phasers — coexists in one chunk
+#                          fine; no pair entry needed.
 #   test_zz_selfheal.py    self-healing plane: retry policy, breakers,
 #                          quorum repair, stale serving (host-only,
 #                          structural crypto; ~5 s)
