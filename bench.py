@@ -355,6 +355,148 @@ def bench_deal_verify(trials, n=128):
             "vs_baseline": None}
 
 
+def bench_dkg_ceremony(trials):
+    """Large-group ceremonies (ISSUE 19), host-only, runs FIRST (before
+    backend init — like client_catchup, the record must land even with
+    the tunnel down, and a stray dispatch must not kick a cold backend
+    probe).
+
+    Two measurements:
+    - REAL-crypto per-receiver deal verification at n dealers: the
+      batched phase admission (batch.parse_commits lockstep membership
+      + one eval_commits dispatch + one fixed-base-comb share_checks
+      pass) vs the reference-shaped sequential loop
+      (from_bytes(subgroup_check=True) per point, per-dealer Horner,
+      generator ladder per share). The sequential side is sampled over
+      BENCH_DKG_SEQ_SAMPLE dealers and extrapolated — at n=256 the full
+      loop would be ~2 minutes of pure baseline.
+    - STRUCTURAL n=256 ceremony + 256→256 reshare wall time with the
+      flight recorder's per-phase seconds (testing/dkg_scale — the
+      protocol machinery at scale; the crypto speedup is the first
+      number's job)."""
+    from drand_tpu.crypto import batch as _batch
+    saved_mode = _batch._MODE
+    _batch.configure("host")
+    try:
+        return _bench_dkg_ceremony(trials)
+    finally:
+        _batch.configure(saved_mode)
+
+
+def _bench_dkg_ceremony(trials):
+    import asyncio
+    import random
+
+    from drand_tpu.crypto import batch, ecies
+    from drand_tpu.crypto.curves import PointG1
+    from drand_tpu.crypto.fields import R
+    from drand_tpu.crypto.poly import PriPoly, PubPoly
+    from drand_tpu.key.keys import new_key_pair
+    from drand_tpu.obs.flight import FLIGHT
+    from drand_tpu.testing import dkg_scale
+
+    n = int(os.environ.get("BENCH_DKG_N", "256"))
+    t = int(os.environ.get("BENCH_DKG_T", "25"))
+    seq_sample = min(n, int(os.environ.get("BENCH_DKG_SEQ_SAMPLE", "16")))
+
+    # ---- A: real-crypto deal verification for ONE receiver, n dealers
+    rnd = random.Random(20260807)
+    me = new_key_pair("bench-recv.test:9000", seed=b"bench-dkg-recv")
+    my_index = 3
+    g = PointG1.generator()
+    log(f"  building {n} dealer bundles (t={t}, real crypto)...")
+    polys = [PriPoly([rnd.randrange(1, R) for _ in range(t)])
+             for _ in range(n)]
+    pubs = [p.commit() for p in polys]
+    wires = [tuple(c.to_bytes() for c in pub.commits) for pub in pubs]
+    cts = [ecies.encrypt(me.public.key,
+                         p.eval(my_index).value.to_bytes(32, "big"))
+           for p in polys]
+
+    def verify_seq(idxs):
+        out = []
+        for i in idxs:
+            pts = [PointG1.from_bytes(c, subgroup_check=True)
+                   for c in wires[i]]
+            ev = PubPoly(pts).eval(my_index).value
+            s = int.from_bytes(ecies.decrypt(me.key, cts[i]), "big") % R
+            out.append(g.mul(s) == ev)
+        return out
+
+    def verify_batched(idxs):
+        parsed = batch.parse_commits([wires[i] for i in idxs])
+        evs = batch.eval_commits([PubPoly(p) for p in parsed], my_index)
+        vals = [int.from_bytes(ecies.decrypt(me.key, cts[i]), "big") % R
+                for i in idxs]
+        return batch.share_checks(list(zip(vals, evs)))
+
+    # correctness gate before timing: verdicts bit-identical on a good
+    # sample AND on a corrupted dealer (bad share → False on both sides)
+    sample = list(range(seq_sample))
+    good_ct = cts[1]
+    cts[1] = ecies.encrypt(me.public.key, (99).to_bytes(32, "big"))
+    seq_v, bat_v = verify_seq(sample), verify_batched(sample)
+    if seq_v != bat_v or bat_v[1] or not all(
+            v for k, v in enumerate(bat_v) if k != 1):
+        raise RuntimeError(f"verdict mismatch: seq={seq_v} batched={bat_v}")
+    cts[1] = good_ct
+
+    log(f"  sequential baseline over {seq_sample} dealers...")
+    t0 = time.perf_counter()
+    if not all(verify_seq(sample)):
+        raise RuntimeError("sequential verify failed")
+    dt_seq = (time.perf_counter() - t0) * n / seq_sample
+
+    def timed_batched():
+        t0 = time.perf_counter()
+        if not all(verify_batched(range(n))):
+            raise RuntimeError("batched verify failed")
+        return time.perf_counter() - t0
+
+    dt_bat = best_of(trials, timed_batched)
+    speedup = dt_seq / dt_bat
+
+    # ---- B: structural n-node ceremony + n→n reshare, per-phase timing
+    log(f"  structural n={n} ceremony + reshare...")
+
+    async def run_scale():
+        pairs, nodes = dkg_scale.make_group(n, prefix="bench-scale")
+        with dkg_scale.structural_dkg_crypto():
+            FLIGHT.dkg.reset()
+            t0 = time.perf_counter()
+            res = await dkg_scale.run_ceremony(n, t, pairs=pairs,
+                                               nodes=nodes)
+            dt_c = time.perf_counter() - t0
+            dkg_scale.check_structural_consistency(res, t)
+            key = res[0].commits[0]
+            tl_c = dkg_scale.phase_timeline(mode="dkg")
+            FLIGHT.dkg.reset()
+            t0 = time.perf_counter()
+            res2 = await dkg_scale.run_reshare(res, pairs, nodes,
+                                              t_old=t, t_new=t)
+            dt_r = time.perf_counter() - t0
+            dkg_scale.check_structural_consistency(res2, t,
+                                                   expected_key=key)
+            tl_r = dkg_scale.phase_timeline(mode="reshare")
+            FLIGHT.dkg.reset()
+        return dt_c, tl_c, dt_r, tl_r
+
+    dt_cer, tl_cer, dt_res, tl_res = asyncio.run(run_scale())
+
+    return {"metric": "dkg_deal_verify_batched_speedup",
+            "value": round(speedup, 2), "unit": "x", "n": n, "t": t,
+            "sequential_seconds": round(dt_seq, 2),
+            "sequential_sampled_dealers": seq_sample,
+            "batched_seconds": round(dt_bat, 3),
+            "ceremony_seconds": round(dt_cer, 1),
+            "ceremony_phase_seconds":
+                {k: round(v, 2) for k, v in tl_cer.items()},
+            "reshare_seconds": round(dt_res, 1),
+            "reshare_phase_seconds":
+                {k: round(v, 2) for k, v in tl_res.items()},
+            "vs_baseline": None}
+
+
 def bench_e2e(trials=1, n=5, t=3, rounds=4):
     """3-of-5 network end-to-end on the in-process harness (fake clock,
     real crypto/aggregation; demo/main.go:41-45 analogue). This config is
@@ -1883,9 +2025,9 @@ def main() -> None:
     t_start = time.perf_counter()
     which = os.environ.get(
         "BENCH_CONFIGS",
-        "client_catchup,msm,glv4,rlc,obs,flight,incident,remediate,chaos,"
-        "timelock,fanout,segstore,shard,e2e,catchup,recover,deal,replay,"
-        "headline").split(",")
+        "dkg_ceremony,client_catchup,msm,glv4,rlc,obs,flight,incident,"
+        "remediate,chaos,timelock,fanout,segstore,shard,e2e,catchup,"
+        "recover,deal,replay,headline").split(",")
 
     # --- outage-proofing (round-3 lesson: the official record must never
     # be an unparseable traceback). Two layers:
@@ -1945,6 +2087,17 @@ def main() -> None:
     # the host-only configs run FIRST, before backend init: their
     # records must land even when the tunnel is down (that is the point
     # of having host-measured aux metrics in the trajectory)
+    if "dkg_ceremony" in which:
+        log("== large-group DKG: batched deal verify n=256 + structural "
+            "ceremony/reshare per-phase timing (host-only) ==")
+        try:
+            emit(bench_dkg_ceremony(trials))
+        except Exception as e:  # noqa: BLE001 — best-effort aux config
+            import traceback
+
+            log(traceback.format_exc())
+            diag("aux_config_failed", config="dkg_ceremony",
+                 error=f"{type(e).__name__}: {e}")
     if "client_catchup" in which:
         log("== million-client catch-up: 1M-round strict walk, adaptive "
             "RLC chunks + pipeline + checkpoint bootstrap (host-only) ==")

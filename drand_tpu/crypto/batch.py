@@ -35,8 +35,8 @@ from collections import deque
 
 import numpy as np
 
-from . import batch_verify, tbls
-from .curves import PointG1
+from . import batch_verify, endo, tbls
+from .curves import PointG1, g1_comb_mul
 from .hash_to_curve import DEFAULT_DST_G2
 from .poly import PubPoly
 
@@ -558,3 +558,102 @@ def eval_commits(polys: list[PubPoly], index: int) -> list[PointG1]:
             _note_fallback("eval_commits", e)
     with _timed("eval_commits", "host", len(polys)):
         return [p.eval(index).value for p in polys]
+
+
+def parse_commits(bundles) -> list:
+    """Decompress + subgroup-check EVERY pending deal bundle's commitment
+    points in one host pass — ``bundles`` is a list of per-dealer byte
+    tuples; the result aligns with it, ``None`` marking a rejected bundle
+    (malformed encoding, or any point outside G1). Acceptance set is
+    bit-identical to the sequential
+    ``PointG1.from_bytes(c, subgroup_check=True)`` loop: decompression
+    runs per point (the sqrt is unavoidable), while the dominant
+    membership check runs as ONE lockstep chain over every pending point
+    (crypto/endo.subgroup_check_fast_g1_many). Membership stays strictly
+    per-point — an RLC aggregate has soundness 1/3 here (the order-3
+    cofactor component cancels), so the batching lever is the shared
+    fixed-[M] chain, not aggregation."""
+    n = sum(len(b) for b in bundles)
+    with _timed("parse_commits", "host", n):
+        parsed = []
+        for cs in bundles:
+            try:
+                parsed.append([PointG1.from_bytes(c, subgroup_check=False)
+                               for c in cs])
+            except ValueError:
+                parsed.append(None)
+        flat = [pt for pts in parsed if pts is not None for pt in pts]
+        verdicts = iter(endo.subgroup_check_fast_g1_many(flat))
+        out = []
+        for pts in parsed:
+            if pts is None:
+                out.append(None)
+                continue
+            # consume ALL lane verdicts before deciding (a short-circuit
+            # would desync the iterator from the flat lane order)
+            oks = [next(verdicts) for _ in pts]
+            out.append(pts if all(oks) else None)
+        return out
+
+
+def share_checks(pairs) -> list[bool]:
+    """``g·s == expected`` for every pending share of a DKG phase in one
+    call — ``pairs`` = [(scalar, expected_point)]. The fixed-base comb
+    (crypto/curves.g1_comb_mul, the shared timelock 8-bit table) replaces
+    a 255-bit generator ladder per share; verdicts are bit-identical to
+    ``PointG1.generator().mul(s % R) == expected``."""
+    with _timed("dkg_share_checks", "host", len(pairs)):
+        return [g1_comb_mul(s) == exp for s, exp in pairs]
+
+
+def eval_poly_indices(pub_poly: PubPoly, indices: list[int]) -> list[PointG1]:
+    """ONE committed polynomial evaluated at MANY indices — the dual of
+    :func:`eval_commits`, used by justification verification (one
+    complained dealer, all its complained share indices per phase) and
+    the reshare binding's device path. Device: the KAT-gated per-lane
+    index graph (ops/engine.eval_poly_indices); host: the memoized
+    Horner oracle (PubPoly.eval_many)."""
+    if _use_device(len(indices)):
+        try:
+            _note_dispatch("eval_poly_indices")
+            with _timed("eval_poly_indices", "device", len(indices)):
+                out = engine().eval_poly_indices(pub_poly, indices)
+            _note_device_ok()
+            return out
+        except Exception as e:  # noqa: BLE001
+            if _MODE == "device":
+                raise
+            _note_fallback("eval_poly_indices", e)
+    with _timed("eval_poly_indices", "host", len(indices)):
+        return [s.value for s in pub_poly.eval_many(indices)]
+
+
+def reshare_bindings(old_pub: PubPoly, items) -> list[bool]:
+    """Dual-group binding verdicts for ALL dealers of a reshare deal
+    phase in one dispatch — ``items`` = [(dealer_index, constant_commit)],
+    each required to satisfy ``old_pub.eval(dealer_index) == commit``.
+    Device: one eval_poly_indices dispatch plus exact compares; host
+    above the RLC threshold: the 2-MSM combined verdict
+    (batch_verify.reshare_bindings_rlc, bisecting to the exact Horner
+    oracle); host otherwise: the memoized per-dealer loop. Caller
+    contract for the RLC tier: every constant commit was already
+    subgroup-checked (parse_commits) — the combination's 2^-128
+    soundness argument requires all points in G1."""
+    n = len(items)
+    if _use_device(n):
+        try:
+            _note_dispatch("reshare_bindings")
+            with _timed("reshare_bindings", "device", n):
+                evs = engine().eval_poly_indices(
+                    old_pub, [i for i, _ in items])
+            _note_device_ok()
+            return [ev == q for ev, (_, q) in zip(evs, items)]
+        except Exception as e:  # noqa: BLE001
+            if _MODE == "device":
+                raise
+            _note_fallback("reshare_bindings", e)
+    if _use_rlc(n):
+        with _timed("reshare_bindings", "host_rlc", n):
+            return batch_verify.reshare_bindings_rlc(old_pub, items)
+    with _timed("reshare_bindings", "host", n):
+        return [old_pub.eval(i).value == q for i, q in items]

@@ -67,7 +67,7 @@ import numpy as np
 
 from . import endo, tbls
 from .curves import PointG1, PointG2, _JacobianPoint
-from .fields import X_BLS
+from .fields import R as FR_ORDER, X_BLS
 from .hash_to_curve import DEFAULT_DST_G2, hash_to_g2
 from .pairing import pairing_check, pairing_check_groups
 from .poly import PubPoly
@@ -447,4 +447,58 @@ def verify_partials_rlc(pub_poly: PubPoly, msg: bytes, partials,
         return tbls.verify_partial(pub_poly, msg, partials[pos], dst)
 
     _resolve(items, out, leaf, None, msg_pt)
+    return out
+
+
+def reshare_bindings_rlc(old_pub: PubPoly, items) -> list[bool]:
+    """Reshare dual-group binding verdicts for a whole deal phase as ONE
+    combined check: ``items`` = [(dealer_index, Q_d)] where Q_d is the
+    dealer's constant-term commitment, which the protocol requires to
+    equal ``old_pub.eval(dealer_index)``. With fresh 128-bit scalars c_d
+    (rlc_scalars) and x_d = dealer_index + 1, all n Horner walks fold
+    into two MSMs:
+
+        Σ_d c_d·Q_d  ==  Σ_k (Σ_d c_d·x_d^k mod r)·C_k
+
+    — one n-point 128-bit MSM over the constant terms plus one t-point
+    full-width MSM over the OLD commits (the "one multi-point evaluation,
+    not n Horner walks" shape). Soundness 2^-128 PER SPAN **provided
+    every Q_d and old commit lies in G1** — that is the caller's
+    contract (deal admission subgroup-checks all parsed commits first;
+    old_pub comes from the trusted group file). On a failed span the
+    resolver bisects with fresh scalars per half down to the exact
+    per-dealer Horner oracle, so the bool list is bit-identical to
+    ``[old_pub.eval(i).value == q for i, q in items]`` on every input.
+    """
+    out = [False] * len(items)
+
+    def span_pass(span) -> bool:
+        cs = rlc_scalars(len(span))
+        lhs = msm([q for _, _, q in span], cs)
+        t = len(old_pub.commits)
+        ws = [0] * t
+        for (_, idx, _), c in zip(span, cs):
+            xp = 1
+            x = idx + 1  # kyber abscissa convention (poly._x_of)
+            for k in range(t):
+                ws[k] = (ws[k] + c * xp) % FR_ORDER
+                xp = xp * x % FR_ORDER
+        return lhs == msm(old_pub.commits, ws)
+
+    def resolve(span) -> None:
+        if not span:
+            return
+        if len(span) == 1:
+            pos, idx, q = span[0]
+            out[pos] = old_pub.eval(idx).value == q
+            return
+        if span_pass(span):
+            for pos, _, _ in span:
+                out[pos] = True
+            return
+        mid = len(span) // 2
+        resolve(span[:mid])
+        resolve(span[mid:])
+
+    resolve([(pos, idx, q) for pos, (idx, q) in enumerate(items)])
     return out

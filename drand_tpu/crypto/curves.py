@@ -13,6 +13,7 @@ polynomials), never hard-coded.
 from __future__ import annotations
 
 import hashlib
+import threading
 from typing import Iterable
 
 from .fields import (
@@ -306,6 +307,54 @@ class PointG2(_JacobianPoint):
         _, y = self.to_affine()
         neg = -y
         return (y.c1, y.c0) > (neg.c1, neg.c0)
+
+
+# ---------------------------------------------------------------------------
+# Fixed-base comb for G1 generator multiples (promoted from crypto/timelock,
+# which keeps aliases): every g·s share-side check in the DKG and both
+# timelock hot sites multiply the SAME base, so an 8-bit windowed table
+# (32 windows × 255 multiples, built lazily once) turns a 255-bit
+# double-and-add ladder into ≤ 32 additions per scalar.
+# ---------------------------------------------------------------------------
+
+_COMB_WINDOW = 8
+_G1_COMB_TABLE: list[list["PointG1"]] | None = None
+_G1_COMB_LOCK = threading.Lock()
+
+
+def _g1_comb_table() -> list[list["PointG1"]]:
+    global _G1_COMB_TABLE
+    if _G1_COMB_TABLE is None:
+        with _G1_COMB_LOCK:
+            if _G1_COMB_TABLE is None:
+                table = []
+                base = PointG1.generator()
+                for _ in range(-(-255 // _COMB_WINDOW)):
+                    row = [PointG1.infinity(), base]
+                    for _d in range(2, 1 << _COMB_WINDOW):
+                        row.append(row[-1] + base)
+                    table.append(row)
+                    for _s in range(_COMB_WINDOW):
+                        base = base.double()
+                _G1_COMB_TABLE = table
+    return _G1_COMB_TABLE
+
+
+def g1_comb_mul(k: int) -> "PointG1":
+    """k * G1 via the fixed-base comb (equal to generator().mul(k))."""
+    k %= R
+    if k == 0:
+        return PointG1.infinity()
+    table = _g1_comb_table()
+    acc = PointG1.infinity()
+    i = 0
+    while k:
+        d = k & ((1 << _COMB_WINDOW) - 1)
+        if d:
+            acc = acc + table[i][d]
+        k >>= _COMB_WINDOW
+        i += 1
+    return acc
 
 
 def _import_self_test() -> None:

@@ -80,11 +80,28 @@ class PriPoly:
             acc = (acc * x + c) % R
         return PriShare(index, acc)
 
+    def eval_many(self, indices: list[int]) -> list[PriShare]:
+        """All shares in ONE Horner sweep: per coefficient, one vectorized
+        lane update instead of n independent walks — the dealing-side
+        batch for large-group DKG (a n=1024 dealer evaluates its poly at
+        every receiver index)."""
+        xs = [_x_of(i) for i in indices]
+        accs = [0] * len(xs)
+        for c in reversed(self.coeffs):
+            accs = [(a * x + c) % R for a, x in zip(accs, xs)]
+        return [PriShare(i, a) for i, a in zip(indices, accs)]
+
     def shares(self, n: int) -> list[PriShare]:
         return [self.eval(i) for i in range(n)]
 
     def commit(self, base: _JacobianPoint | None = None) -> "PubPoly":
-        base = base if base is not None else PointG1.generator()
+        if base is None:
+            # fixed-base comb for the default G1 generator — same group
+            # elements as generator().mul(c), ~8x cheaper per coefficient
+            from .curves import g1_comb_mul
+
+            return PubPoly([g1_comb_mul(c) for c in self.coeffs],
+                           PointG1.generator())
         return PubPoly([base.mul(c) for c in self.coeffs], base)
 
     def add(self, other: "PriPoly") -> "PriPoly":
@@ -128,6 +145,15 @@ class PubPoly:
         share = PubShare(index, acc)
         self._eval_cache[index] = share
         return share
+
+    def eval_many(self, indices: list[int]) -> list[PubShare]:
+        """Host multi-point evaluation (memoized per index). This is the
+        exact ORACLE for the batched forms of the same computation — the
+        device `engine.eval_poly_indices` dispatch and the msm-backed RLC
+        binding verdict both route through `crypto.batch.eval_poly_indices`
+        / `batch_verify.reshare_bindings_rlc`, which fall back to and are
+        bisection-checked against this loop."""
+        return [self.eval(i) for i in indices]
 
     def add(self, other: "PubPoly") -> "PubPoly":
         if self.threshold != other.threshold:

@@ -96,51 +96,15 @@ def _xor(a: bytes, b: bytes) -> bytes:
 
 # ---------------------------------------------------------------------------
 # Fixed-base comb for generator scalar muls. Both hot sites multiply the
-# G1 GENERATOR — encrypt's U = r*G1 and the FO re-encryption check — so a
-# one-time 8-bit windowed table (32 windows x 255 odd multiples, built
-# lazily) turns a 255-step double-and-add into <= 31 point additions.
-# The result is the same group element `generator().mul(k)` returns, so
-# accept/reject semantics are untouched.
+# G1 GENERATOR — encrypt's U = r*G1 and the FO re-encryption check. The
+# comb itself now lives in crypto/curves (g1_comb_mul) so the DKG's
+# batched g·s share checks share the same one-time table; these aliases
+# keep the historical timelock-local names importable.
 # ---------------------------------------------------------------------------
 
-_COMB_WINDOW = 8
-_COMB_TABLE: list[list[PointG1]] | None = None
-_COMB_LOCK = threading.Lock()
-
-
-def _comb_table() -> list[list[PointG1]]:
-    global _COMB_TABLE
-    if _COMB_TABLE is None:
-        with _COMB_LOCK:
-            if _COMB_TABLE is None:
-                table = []
-                base = PointG1.generator()
-                for _ in range(-(-255 // _COMB_WINDOW)):
-                    row = [PointG1.infinity(), base]
-                    for _d in range(2, 1 << _COMB_WINDOW):
-                        row.append(row[-1] + base)
-                    table.append(row)
-                    for _s in range(_COMB_WINDOW):
-                        base = base.double()
-                _COMB_TABLE = table
-    return _COMB_TABLE
-
-
-def _gen_mul(k: int) -> PointG1:
-    """k * G1 via the fixed-base comb (equal to generator().mul(k))."""
-    k %= R
-    if k == 0:
-        return PointG1.infinity()
-    table = _comb_table()
-    acc = PointG1.infinity()
-    i = 0
-    while k:
-        d = k & ((1 << _COMB_WINDOW) - 1)
-        if d:
-            acc = acc + table[i][d]
-        k >>= _COMB_WINDOW
-        i += 1
-    return acc
+from .curves import _COMB_WINDOW  # noqa: E402,F401 — compat re-export
+from .curves import _g1_comb_table as _comb_table  # noqa: E402,F401
+from .curves import g1_comb_mul as _gen_mul  # noqa: E402
 
 
 # ---------------------------------------------------------------------------

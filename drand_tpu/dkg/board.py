@@ -129,6 +129,19 @@ class BroadcastBoard(Board):
         if not self._verify(bundle):
             self._l.debug("dkg_board", "invalid_bundle",
                           kind=type(bundle).__name__)
+            from .. import metrics
+
+            # phase is branch-literal per bundle type (the
+            # KNOWN_LABEL_VALUES lint checks literal label kwargs)
+            if isinstance(bundle, DealBundle):
+                metrics.DKG_BUNDLE_REJECTS.labels(
+                    phase="deal", verdict="bad_signature").inc()
+            elif isinstance(bundle, ResponseBundle):
+                metrics.DKG_BUNDLE_REJECTS.labels(
+                    phase="response", verdict="bad_signature").inc()
+            else:
+                metrics.DKG_BUNDLE_REJECTS.labels(
+                    phase="justification", verdict="bad_signature").inc()
             return
         self._seen.add(key)
         from .. import metrics

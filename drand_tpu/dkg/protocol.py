@@ -57,6 +57,14 @@ class DKGError(Exception):
     pass
 
 
+# dealing: receivers per asyncio.to_thread ECIES hand-off (bounded chunks
+# keep cancellation responsive and never park a whole n=1024 encrypt run
+# on one executor slot)
+_DEAL_ENC_CHUNK = 32
+# admission: dealers per on-loop work slice between cooperative yields
+_ADMIT_CHUNK = 32
+
+
 @dataclass
 class DKGConfig:
     longterm: Pair
@@ -114,6 +122,7 @@ class DKGProtocol:
         else:
             self._old_pub = None
         self._phaser = TimePhaser(conf.clock, conf.phase_timeout)
+        self._sid: str | None = None  # flight-recorder session (run())
         # receiver state
         self._valid_shares: dict[int, int] = {}      # dealer_index -> f_i(me)
         self._valid_commits: dict[int, PubPoly] = {}  # dealer_index -> G_i
@@ -140,28 +149,32 @@ class DKGProtocol:
             # in-process timelines must not collide
             tag=(f"s{self._share_index}" if self._share_index is not None
                  else f"d{self._dealer_index}"))
+        self._sid = sid
         try:
             FLIGHT.dkg.note_phase(sid, "deal", now=self.c.clock.now())
             my_poly = None
             if self._dealer_index is not None:
                 my_poly = self._make_poly()
-                await self.board.push_deals(self._make_deal_bundle(my_poly))
+                await self.board.push_deals(
+                    await self._make_deal_bundle(my_poly))
 
             deals = await self._collect(
                 self.board.deals, expect=len(dealers),
                 issuer=lambda b: b.dealer_index,
                 note=lambda b: FLIGHT.dkg.note_bundle(
                     sid, "deal", b.dealer_index, now=self.c.clock.now()))
-            # deliberately INLINE (loopblock baseline entry): deal
-            # admission is a batched commitment evaluation + point
-            # muls, but the DKG runs in a dedicated phase-clock-driven
-            # setup window — an executor hand-off here suspends the
-            # node between a phase deadline and its response push, and
-            # a concurrently advancing clock (FakeClock tests;
-            # aggressive operator timeouts) can close the response
-            # window while the thread runs. Bounded: one batched eval
-            # per DKG, not per round.
-            self._process_deals(deals)
+            # deliberately ON-LOOP (loopblock baseline entry): deal
+            # admission is batched host/device crypto, but the DKG runs
+            # in a dedicated phase-clock-driven setup window — an
+            # executor hand-off here suspends the node between a phase
+            # deadline and its response push, and a concurrently
+            # advancing clock (FakeClock tests; aggressive operator
+            # timeouts) can close the response window while the thread
+            # runs. Bounded: a few batched dispatches per DKG, not per
+            # round — and sliced into _ADMIT_CHUNK-dealer chunks with
+            # cooperative yields so a n=1024 admission cannot starve
+            # the phase clock either.
+            await self._process_deals(deals)
 
             FLIGHT.dkg.note_phase(sid, "response", now=self.c.clock.now())
             if self._share_index is not None:
@@ -191,8 +204,7 @@ class DKGProtocol:
                     note=lambda b: FLIGHT.dkg.note_bundle(
                         sid, "justification", b.dealer_index,
                         now=self.c.clock.now()))
-                for b in justs:
-                    self._process_justification(b)
+                self._process_justifications(justs)
 
             FLIGHT.dkg.note_phase(sid, "finish", now=self.c.clock.now())
             result = self._finish(dealers)
@@ -217,71 +229,169 @@ class DKGProtocol:
                   for k in range(self.c.threshold)]
         return PriPoly(coeffs)
 
-    def _make_deal_bundle(self, poly: PriPoly) -> DealBundle:
-        commits = tuple(c.to_bytes() for c in poly.commit().commits)
-        deals = []
-        for node in self.c.new_nodes:
-            s = poly.eval(node.index)
-            enc = ecies.encrypt(node.identity.key, s.value.to_bytes(32, "big"))
-            deals.append(Deal(share_index=node.index, encrypted_share=enc))
+    async def _make_deal_bundle(self, poly: PriPoly) -> DealBundle:
+        """Dealing made O(n)-cheap for large groups: all receiver
+        evaluations in ONE scalar Horner sweep (PriPoly.eval_many), the
+        commitment via the fixed-base comb (PriPoly.commit), and the n
+        ECIES encrypts — two 255-bit point muls each, the dominant
+        dealing cost at n=1024 — handed to ``asyncio.to_thread`` in
+        bounded chunks so the dealer never parks the event loop behind
+        ~30 s of sequential encryption. Dealing runs BEFORE the dealer
+        enters its deal-phase collect, so the thread hand-off here has
+        no phase-deadline interplay (unlike admission, which stays
+        on-loop — see _process_deals)."""
+        nodes = self.c.new_nodes
+        commit_pts, shares = await self._offload(
+            lambda: (poly.commit().commits,
+                     poly.eval_many([n.index for n in nodes])))
+        commits = tuple(c.to_bytes() for c in commit_pts)
+        deals: list[Deal] = []
+        for s0 in range(0, len(nodes), _DEAL_ENC_CHUNK):
+            deals.extend(await self._offload(
+                self._encrypt_deals, nodes[s0:s0 + _DEAL_ENC_CHUNK],
+                shares[s0:s0 + _DEAL_ENC_CHUNK]))
         bundle = DealBundle(
             dealer_index=self._dealer_index, commits=commits,
             deals=tuple(deals), session_id=self.c.nonce)
         return _signed(bundle, self.c.longterm)
 
-    def _process_deals(self, bundles) -> None:
-        """Process a phase's deal bundles: admit commitments one by one,
-        then check our encrypted shares against ONE batched commitment
-        evaluation at our index (crypto.batch.eval_commits — the
-        reference's per-dealer vss.VerifyDeal loop as a single device
-        call; the secret share side g·s stays on the host)."""
-        pend = []
+    async def _offload(self, fn, *args):
+        """Dealing work goes to a worker thread ONLY on the wall clock.
+        A FakeClock test driver advances time whenever the loop is idle
+        — a dealer parked in ``asyncio.to_thread`` registers no clock
+        waiter, so the driver would burn every phase window in real
+        milliseconds while the thread still deals (the crashed-dealer
+        FakeClock test deadlocks exactly so). Deterministic clocks keep
+        dealing inline, with a cooperative yield per chunk instead."""
+        if isinstance(self.c.clock, SystemClock):
+            return await asyncio.to_thread(fn, *args)
+        res = fn(*args)
+        await asyncio.sleep(0)
+        return res
+
+    @staticmethod
+    def _encrypt_deals(nodes: list[Node], shares) -> list[Deal]:
+        return [Deal(share_index=n.index,
+                     encrypted_share=ecies.encrypt(
+                         n.identity.key, s.value.to_bytes(32, "big")))
+                for n, s in zip(nodes, shares)]
+
+    async def _process_deals(self, bundles) -> None:
+        """Admit a phase's deal bundles and check our own shares, every
+        per-dealer check batched into ONE dispatch per kind per phase:
+
+        - parse: ``batch.parse_commits`` — decompression plus one
+          lockstep G1 membership chain over every pending commit point;
+        - reshare binding: ``batch.reshare_bindings`` — all dealers'
+          ``old_pub.eval(dealer_index)`` as one multi-point evaluation
+          (device) or one RLC 2-MSM verdict (host), not n Horner walks;
+        - own share: ``batch.eval_commits`` (every admitted polynomial
+          at our index, one dispatch) + ``batch.share_checks`` (every
+          g·s through one fixed-base-comb pass).
+
+        The work stays ON the event loop (the loopblock baseline entry
+        documents why an executor hand-off is worse here) but is sliced
+        into _ADMIT_CHUNK-dealer chunks with a cooperative yield
+        between slices, so a n=1024 admission cannot starve the phase
+        clock (tests/test_zz_dkg_scale.py proves the response window
+        still closes under FakeClock). Rejections are attributable:
+        each mints dkg_bundle_rejects_total{phase,verdict} and a
+        flight-recorder note instead of a silent drop."""
+        from .. import metrics
+
+        pending = []
         for b in bundles:
-            pub = self._admit_deal_commits(b)
-            if pub is not None and self._share_index is not None:
-                pend.append((b, pub))
-        if not pend:
+            if b.dealer_index in self._valid_commits:
+                continue  # first bundle per dealer wins (_collect dedups)
+            if len(b.commits) != self.c.threshold:
+                metrics.DKG_BUNDLE_REJECTS.labels(
+                    phase="deal", verdict="wrong_threshold").inc()
+                self._note_reject("deal", "wrong_threshold",
+                                  b.dealer_index)
+                continue
+            pending.append(b)
+
+        admitted: list[tuple[DealBundle, PubPoly]] = []
+        for s0 in range(0, len(pending), _ADMIT_CHUNK):
+            chunk = pending[s0:s0 + _ADMIT_CHUNK]
+            for b, pts in zip(chunk,
+                              batch.parse_commits(
+                                  [b.commits for b in chunk])):
+                if pts is None:
+                    metrics.DKG_BUNDLE_REJECTS.labels(
+                        phase="deal", verdict="bad_point").inc()
+                    self._note_reject("deal", "bad_point", b.dealer_index)
+                    continue
+                admitted.append((b, PubPoly(pts)))
+            await asyncio.sleep(0)
+
+        if self._old_pub is not None and admitted:
+            # dealer constant terms must be their OLD public shares —
+            # the key-preservation binding of a reshare, decided for
+            # the whole phase in one batched dispatch
+            verdicts = batch.reshare_bindings(
+                self._old_pub,
+                [(b.dealer_index, pub.commit()) for b, pub in admitted])
+            kept = []
+            for (b, pub), ok in zip(admitted, verdicts):
+                if not ok:
+                    metrics.DKG_BUNDLE_REJECTS.labels(
+                        phase="deal", verdict="binding_mismatch").inc()
+                    self._note_reject("deal", "binding_mismatch",
+                                      b.dealer_index)
+                    continue
+                kept.append((b, pub))
+            admitted = kept
+
+        for b, pub in admitted:
+            self._valid_commits[b.dealer_index] = pub
+        if self._share_index is None or not admitted:
             return
-        evals = batch.eval_commits([pub for _, pub in pend],
+
+        evals = batch.eval_commits([pub for _, pub in admitted],
                                    self._share_index)
-        for (b, pub), ev in zip(pend, evals):
-            self._check_own_share(b, ev)
+        checks: list[tuple[int, int, PointG1]] = []
+        for s0 in range(0, len(admitted), _ADMIT_CHUNK):
+            for (b, _), ev in zip(admitted[s0:s0 + _ADMIT_CHUNK],
+                                  evals[s0:s0 + _ADMIT_CHUNK]):
+                val = self._decrypt_own_deal(b)
+                if val is not None:
+                    checks.append((b.dealer_index, val, ev))
+            await asyncio.sleep(0)
+        oks = batch.share_checks([(val, ev) for _, val, ev in checks])
+        for (dealer, val, _), ok in zip(checks, oks):
+            if ok:
+                self._valid_shares[dealer] = val
+            else:
+                metrics.DKG_BUNDLE_REJECTS.labels(
+                    phase="deal", verdict="bad_share").inc()
+                self._note_reject("deal", "bad_share", dealer)
 
-    def _admit_deal_commits(self, b: DealBundle) -> PubPoly | None:
-        """Commitment-shape and reshare-binding validation; records the
-        dealer's PubPoly. Returns it if newly admitted."""
-        if b.dealer_index in self._valid_commits:
-            return None  # first valid bundle per dealer wins
-        if len(b.commits) != self.c.threshold:
-            return None
-        try:
-            pub = PubPoly(b.commit_points())
-        except ValueError:
-            return None
-        if self._old_pub is not None:
-            # dealer's constant term must be its OLD public share —
-            # the key-preservation binding of a reshare
-            if pub.commit() != self._old_pub.eval(b.dealer_index).value:
-                self._l.warn("dkg", "reshare_commit_mismatch",
-                             dealer=b.dealer_index)
-                return None
-        self._valid_commits[b.dealer_index] = pub
-        return pub
-
-    def _check_own_share(self, b: DealBundle, eval_point: PointG1) -> None:
-        """Decrypt our deal from this bundle and accept the share iff
-        g·s equals the dealer's commitment polynomial at our index."""
+    def _decrypt_own_deal(self, b: DealBundle) -> int | None:
+        """Our share value from this bundle's deal for our index, or
+        None (no deal for us / malformed ciphertext — the latter leads
+        to a complaint exactly as a bad share does)."""
         for d in b.deals:
             if d.share_index != self._share_index:
                 continue
             try:
-                plain = ecies.decrypt(self.c.longterm.key, d.encrypted_share)
-                val = int.from_bytes(plain, "big") % R
+                plain = ecies.decrypt(self.c.longterm.key,
+                                      d.encrypted_share)
+                return int.from_bytes(plain, "big") % R
             except Exception:  # noqa: BLE001 — malformed ciphertext
-                break
-            if PointG1.generator().mul(val) == eval_point:
-                self._valid_shares[b.dealer_index] = val
-            break
+                return None
+        return None
+
+    def _note_reject(self, phase: str, verdict: str, issuer: int) -> None:
+        """Log + flight-note one rejected bundle/item. The
+        dkg_bundle_rejects_total counter is minted branch-literally at
+        each call site (tools/check_metrics.py KNOWN_LABEL_VALUES lints
+        literal label kwargs only)."""
+        self._l.warn("dkg", "bundle_reject", phase=phase, verdict=verdict,
+                     issuer=issuer)
+        if self._sid is not None:
+            FLIGHT.dkg.note_reject(self._sid, phase, issuer, verdict,
+                                   now=self.c.clock.now())
 
     # ----------------------------------------------------------- responses
     def _make_response_bundle(self, dealers: list[Node]) -> ResponseBundle:
@@ -297,9 +407,15 @@ class DKGProtocol:
         return _signed(bundle, self.c.longterm)
 
     def _process_response(self, b: ResponseBundle, dealers: list[Node]) -> None:
+        from .. import metrics
+
         dealer_idxs = {n.index for n in dealers}
         for r in b.responses:
             if r.dealer_index not in dealer_idxs:
+                metrics.DKG_BUNDLE_REJECTS.labels(
+                    phase="response", verdict="unknown_dealer").inc()
+                self._note_reject("response", "unknown_dealer",
+                                  b.share_index)
                 continue
             if r.status == STATUS_COMPLAINT:
                 self._complaints_open.setdefault(r.dealer_index, set()).add(
@@ -319,20 +435,48 @@ class DKGProtocol:
             session_id=self.c.nonce)
         return _signed(bundle, self.c.longterm)
 
-    def _process_justification(self, b: JustificationBundle) -> None:
-        pub = self._valid_commits.get(b.dealer_index)
-        opened = self._complaints_open.get(b.dealer_index, set())
-        if pub is None or not opened:
-            return
-        for j in b.justifications:
-            if j.share_index not in opened:
+    def _process_justifications(self, bundles) -> None:
+        """A phase's justification bundles verified in batch: each
+        complained dealer's admitted commitment polynomial evaluated at
+        ALL its disputed share indices in one dispatch
+        (crypto.batch.eval_poly_indices — the many-indices dual of
+        eval_commits), then every revealed-share g·s check through one
+        fixed-base-comb pass (crypto.batch.share_checks) — replacing
+        the per-bundle 255-bit generator ladders of the old
+        _process_justification. State transitions are identical to the
+        sequential loop: a passing justification closes the complaint
+        (and, for our own index, adopts the now-public share); a
+        failing one leaves it open and mints an attributable reject."""
+        from .. import metrics
+
+        work: list[tuple[int, int, int, PointG1]] = []
+        for b in bundles:
+            pub = self._valid_commits.get(b.dealer_index)
+            opened = self._complaints_open.get(b.dealer_index, set())
+            if pub is None or not opened:
                 continue
-            if PointG1.generator().mul(j.share % R) == \
-                    pub.eval(j.share_index).value:
-                opened.discard(j.share_index)
-                if j.share_index == self._share_index:
+            wanted = [j for j in b.justifications
+                      if j.share_index in opened]
+            if not wanted:
+                continue
+            evs = batch.eval_poly_indices(
+                pub, [j.share_index for j in wanted])
+            for j, ev in zip(wanted, evs):
+                work.append((b.dealer_index, j.share_index,
+                             j.share % R, ev))
+        if not work:
+            return
+        oks = batch.share_checks([(val, ev) for _, _, val, ev in work])
+        for (dealer, idx, val, _), ok in zip(work, oks):
+            if ok:
+                self._complaints_open[dealer].discard(idx)
+                if idx == self._share_index:
                     # the revealed (now public) share is still OUR share
-                    self._valid_shares[b.dealer_index] = j.share % R
+                    self._valid_shares[dealer] = val
+            else:
+                metrics.DKG_BUNDLE_REJECTS.labels(
+                    phase="justification", verdict="bad_share").inc()
+                self._note_reject("justification", "bad_share", dealer)
 
     # --------------------------------------------------------------- finish
     def _finish(self, dealers: list[Node]) -> DistKeyShare:
@@ -372,9 +516,12 @@ class DKGProtocol:
                     f"reshare: missing shares from canonical QUAL subset "
                     f"{missing}")
         lambdas = lagrange_coefficients(subset)
+        # generic over the commitment point type (the structural
+        # large-group harness substitutes a stand-in group)
+        cls = type(self._valid_commits[subset[0]].commits[0])
         commits = []
         for k in range(self.c.threshold):
-            acc = PointG1.infinity()
+            acc = cls.infinity()
             for i in subset:
                 acc = acc + self._valid_commits[i].commits[k].mul(lambdas[i])
             commits.append(acc)

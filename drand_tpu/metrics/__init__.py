@@ -233,6 +233,14 @@ DKG_BUNDLES = Counter(
     "dkg_bundles_received", "DKG bundles accepted by the broadcast board",
     ["kind"], registry=GROUP_REGISTRY)
 
+DKG_BUNDLE_REJECTS = Counter(
+    "dkg_bundle_rejects_total",
+    "DKG bundles/items rejected during verification, by phase and "
+    "verdict (bad_signature|wrong_threshold|bad_point|binding_mismatch|"
+    "bad_share|unknown_dealer) — a misbehaving dealer in a large-group "
+    "ceremony is attributable, not silently dropped",
+    ["phase", "verdict"], registry=GROUP_REGISTRY)
+
 # ---- http (public REST server) --------------------------------------------
 HTTP_REQUESTS = Counter(
     "http_api_requests", "Public REST API calls", ["path", "code"],

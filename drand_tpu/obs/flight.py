@@ -469,8 +469,8 @@ class DKGFlight:
                 "threshold": threshold,
                 "phases": [], "bundles": {"deal": {}, "response": {},
                                           "justification": {}},
-                "qual": None, "complaints": {}, "error": None,
-                "done": False, "dropped": 0}
+                "qual": None, "complaints": {}, "rejects": [],
+                "error": None, "done": False, "dropped": 0}
             while len(self._sessions) > self.max_sessions:
                 self._sessions.popitem(last=False)
         return sid
@@ -515,6 +515,22 @@ class DKGFlight:
                 return
             seen[str(issuer)] = round(now - rec["start"], 6)
 
+    def note_reject(self, sid: str, phase: str, issuer: int, verdict: str,
+                    *, now: float) -> None:
+        """A bundle/item from ``issuer`` was rejected during ``phase``
+        verification (verdict names the failed check) — the timeline
+        shows WHO misbehaved, not just that the count dropped."""
+        with self._lock:
+            rec = self._rec(sid)
+            if rec is None:
+                return
+            if len(rec["rejects"]) >= self.max_marks:
+                rec["dropped"] += 1
+                return
+            rec["rejects"].append({"phase": phase, "issuer": issuer,
+                                   "verdict": verdict,
+                                   "t": round(now - rec["start"], 6)})
+
     def finish(self, sid: str, *, now: float, qual: list[int] | None = None,
                complaints: dict | None = None,
                error: str | None = None) -> None:
@@ -548,6 +564,7 @@ class DKGFlight:
                 c["bundles"] = {k: dict(v)
                                 for k, v in rec["bundles"].items()}
                 c["complaints"] = dict(rec["complaints"])
+                c["rejects"] = [dict(r) for r in rec["rejects"]]
                 out.append(c)
         return out
 

@@ -2086,9 +2086,11 @@ class BatchedEngine:
         return oks, _g2_from_affine_dev(rx, ry).to_bytes()
 
 
-# index width for the eval_commits ladder (node indices are tiny; 10 bits
-# covers groups up to n=1022 with one jit shape)
-_EVAL_IDX_BITS = 10
+# index width for the eval_commits ladder (node indices are tiny; 11 bits
+# covers groups up to n=2046 with one jit shape — the large-group ceremony
+# target is n=1024, whose top abscissa x = 1024 overflowed the old 10-bit
+# width)
+_EVAL_IDX_BITS = 11
 
 import functools as _functools
 

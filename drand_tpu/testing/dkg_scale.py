@@ -1,0 +1,262 @@
+"""Large-group DKG/reshare harness (ISSUE 19).
+
+Running a REAL n=256 ceremony in-process means ~n² ECIES point-muls and
+n² share checks — minutes of pairing-class arithmetic that measures the
+bignum library, not the protocol. This module swaps the GROUP, not the
+protocol: :class:`ScalarPoint` is the additive group (Z_r, +) wearing
+the PointG1 interface (``g·s`` is literally ``s``), so every structural
+property the protocol enforces — commitment consistency, share
+verification, complaint/justification state, reshare key preservation —
+still holds or fails exactly as it would on G1, while a full n=256
+ceremony runs in seconds. The discrete log is trivial by design; this
+is a STRUCTURAL harness, never a cryptographic one. Bit-exactness of
+the batched verdicts against the real curve is proven separately at
+smaller n with real crypto (tests/test_zz_dkg_scale.py).
+
+Pattern follows testing/chaos.structural_crypto: save → patch → yield →
+restore in a finally, so a failing test never leaks a patched process.
+Schnorr bundle signatures stay REAL — authentication is cheap (2 muls
+per bundle, not per deal) and keeping it real exercises the board's
+bad_signature reject path at scale.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from contextlib import contextmanager
+
+from ..crypto import batch, ecies
+from ..crypto.fields import R
+from ..crypto.poly import PriPoly, PubPoly
+from ..dkg import DKGConfig, DKGProtocol, LocalBoard
+from ..key.keys import Node, new_key_pair
+from ..obs.flight import FLIGHT
+
+_ENC_MARK = b"SDKG"  # structural-ciphertext marker (decrypt rejects junk)
+
+
+class ScalarPoint:
+    """(Z_r, +) with the PointG1 surface the DKG touches: generator=1,
+    infinity=0, ``mul`` is field multiplication, serialization is a
+    48-byte tag+value that NO real compressed G1 point shares (the
+    0x1f lead byte has the compression bit clear, so a structural
+    commit fed to the real parser is rejected, never confused)."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v: int):
+        self.v = v % R
+
+    @classmethod
+    def infinity(cls) -> "ScalarPoint":
+        return cls(0)
+
+    @classmethod
+    def generator(cls) -> "ScalarPoint":
+        return cls(1)
+
+    def is_infinity(self) -> bool:
+        return self.v == 0
+
+    def mul(self, k: int) -> "ScalarPoint":
+        return ScalarPoint(self.v * (k % R))
+
+    def __add__(self, other: "ScalarPoint") -> "ScalarPoint":
+        return ScalarPoint(self.v + other.v)
+
+    def __neg__(self) -> "ScalarPoint":
+        return ScalarPoint(-self.v)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ScalarPoint) and self.v == other.v
+
+    def __hash__(self) -> int:
+        return hash(("ScalarPoint", self.v))
+
+    def __repr__(self) -> str:
+        return f"ScalarPoint({self.v})"
+
+    def to_bytes(self) -> bytes:
+        return b"\x1f" + self.v.to_bytes(47, "big")
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "ScalarPoint":
+        if len(data) != 48 or data[:1] != b"\x1f":
+            raise ValueError("not a structural point")
+        return cls(int.from_bytes(data[1:], "big"))
+
+
+def _structural_commit(self, base=None) -> PubPoly:
+    if base is None:
+        base = ScalarPoint.generator()
+    return PubPoly([base.mul(c) for c in self.coeffs], base)
+
+
+def _structural_parse_commits(bundles) -> list:
+    out = []
+    for cs in bundles:
+        try:
+            out.append([ScalarPoint.from_bytes(c) for c in cs])
+        except ValueError:
+            out.append(None)
+    return out
+
+
+def _structural_eval_commits(polys, index) -> list:
+    return [p.eval(index).value for p in polys]
+
+
+def _structural_eval_poly_indices(pub_poly, indices) -> list:
+    return [s.value for s in pub_poly.eval_many(indices)]
+
+
+def _structural_share_checks(pairs) -> list[bool]:
+    return [ScalarPoint(s) == exp for s, exp in pairs]
+
+
+def _structural_reshare_bindings(old_pub, items) -> list[bool]:
+    return [old_pub.eval(i).value == q for i, q in items]
+
+
+def _structural_encrypt(public, msg: bytes) -> bytes:
+    return _ENC_MARK + msg
+
+
+def _structural_decrypt(sk: int, ciphertext: bytes) -> bytes:
+    if not ciphertext.startswith(_ENC_MARK):
+        raise ValueError("structural ciphertext marker missing")
+    return ciphertext[len(_ENC_MARK):]
+
+
+@contextmanager
+def structural_dkg_crypto():
+    """Swap the DKG's group/cipher leaves for the scalar stand-ins; the
+    batch dispatchers are replaced wholesale (their host/device paths
+    assume the real curve — structural points must never reach the
+    engine). Everything is restored on exit, including on failure."""
+    saved = (PriPoly.commit, batch.parse_commits, batch.eval_commits,
+             batch.eval_poly_indices, batch.share_checks,
+             batch.reshare_bindings, ecies.encrypt, ecies.decrypt)
+    PriPoly.commit = _structural_commit
+    batch.parse_commits = _structural_parse_commits
+    batch.eval_commits = _structural_eval_commits
+    batch.eval_poly_indices = _structural_eval_poly_indices
+    batch.share_checks = _structural_share_checks
+    batch.reshare_bindings = _structural_reshare_bindings
+    ecies.encrypt = _structural_encrypt
+    ecies.decrypt = _structural_decrypt
+    try:
+        yield
+    finally:
+        (PriPoly.commit, batch.parse_commits, batch.eval_commits,
+         batch.eval_poly_indices, batch.share_checks,
+         batch.reshare_bindings, ecies.encrypt, ecies.decrypt) = saved
+
+
+# ---------------------------------------------------------------------------
+# ceremony drivers
+# ---------------------------------------------------------------------------
+
+def make_group(n: int, prefix: str = "scale") -> tuple[list, list[Node]]:
+    """n deterministic longterm pairs + their Node records (indices
+    0..n-1). Real schnorr keys — bundle signing stays real."""
+    pairs = [new_key_pair(f"{prefix}-{i}.test:9000",
+                          seed=b"%s-%d" % (prefix.encode(), i))
+             for i in range(n)]
+    nodes = [Node(identity=p.public, index=i)
+             for i, p in enumerate(pairs)]
+    return pairs, nodes
+
+
+async def run_ceremony(n: int, t: int, *, nonce: bytes = b"scale-dkg",
+                       seed: bytes = b"scale-seed", clock=None,
+                       phase_timeout: float = 60.0,
+                       pairs=None, nodes=None) -> list:
+    """Fresh n-node ceremony on LocalBoards (fast-sync short-circuits,
+    so wall time is work-bound, not timeout-bound). Returns every
+    node's DistKeyShare. Call under :func:`structural_dkg_crypto` for
+    big n; real crypto works too at small n."""
+    from ..utils.clock import SystemClock
+
+    if pairs is None or nodes is None:
+        pairs, nodes = make_group(n)
+    boards = LocalBoard.make_group(n)
+    clock = clock or SystemClock()
+    configs = [DKGConfig(longterm=pairs[i], nonce=nonce, new_nodes=nodes,
+                         threshold=t, clock=clock,
+                         phase_timeout=phase_timeout, seed=seed)
+               for i in range(n)]
+    return await asyncio.gather(
+        *(DKGProtocol(c, b).run() for c, b in zip(configs, boards)))
+
+
+async def run_reshare(results: list, pairs, nodes, t_old: int, t_new: int,
+                      *, nonce: bytes = b"scale-reshare", clock=None,
+                      seed: bytes = b"scale-reseed",
+                      phase_timeout: float = 60.0,
+                      bad_dealers: tuple[int, ...] = ()) -> list:
+    """Reshare an existing group onto the SAME membership (old group ==
+    new group — the large-group refresh case). ``bad_dealers`` deal
+    from a corrupted old share (constant term off by one): the binding
+    check must exclude exactly those dealers from QUAL."""
+    from ..crypto.poly import PriShare
+    from ..utils.clock import SystemClock
+
+    n = len(nodes)
+    boards = LocalBoard.make_group(n)
+    clock = clock or SystemClock()
+    public_coeffs = list(results[0].commits)
+    configs = []
+    for i in range(n):
+        share = results[i].pri_share
+        if i in bad_dealers and share is not None:
+            share = PriShare(share.index, (share.value + 1) % R)
+        configs.append(DKGConfig(
+            longterm=pairs[i], nonce=nonce, new_nodes=nodes,
+            threshold=t_new, old_nodes=nodes,
+            public_coeffs=public_coeffs, old_threshold=t_old,
+            share=share, clock=clock, phase_timeout=phase_timeout,
+            seed=seed))
+    return await asyncio.gather(
+        *(DKGProtocol(c, b).run() for c, b in zip(configs, boards)))
+
+
+def check_structural_consistency(results: list, t: int,
+                                 expected_key=None) -> PubPoly:
+    """The structural analogue of test_dkg.check_group_consistency:
+    identical commits everywhere, every share satisfies g·s ==
+    pub.eval(i) in the stand-in group, optional group-key pin."""
+    commits0 = results[0].commits
+    for r in results:
+        assert [c.to_bytes() for c in r.commits] == \
+            [c.to_bytes() for c in commits0]
+        assert len(r.commits) == t
+    if expected_key is not None:
+        assert commits0[0] == expected_key
+    pub = PubPoly(list(commits0))
+    for r in results:
+        if r.pri_share is None:
+            continue
+        assert ScalarPoint(r.pri_share.value) == \
+            pub.eval(r.pri_share.index).value
+    return pub
+
+
+def phase_timeline(mode: str | None = None) -> dict[str, float]:
+    """Per-phase seconds from a retained completed flight session (the
+    ring keeps max_sessions=16 of the n begun — any retained DONE
+    session is a representative timeline; every node ran the same
+    phases on the same clock)."""
+    for rec in FLIGHT.dkg.sessions():
+        if not rec["done"] or rec["error"] is not None:
+            continue
+        if mode is not None and rec["mode"] != mode:
+            continue
+        out = {}
+        for p in rec["phases"]:
+            if p["end_s"] is not None:
+                out[p["phase"]] = out.get(p["phase"], 0.0) + \
+                    (p["end_s"] - p["start_s"])
+        if out:
+            return out
+    return {}

@@ -1,0 +1,324 @@
+"""Large-group ceremony batching (ISSUE 19).
+
+Late-alphabet like the other scale suites: the structural harness
+patches module leaves (testing/dkg_scale.structural_dkg_crypto) and
+FLIGHT's DKG ring is process-global, so these tests run after the
+plain crypto suites in a chunk.
+
+Two layers of proof:
+- REAL crypto at small n: every batched phase verdict bit-identical
+  to the per-item oracle it replaced — lockstep G1 membership vs
+  ``in_subgroup``, ``parse_commits`` vs the sequential
+  ``from_bytes(subgroup_check=True)`` loop, comb ``share_checks`` vs
+  generator ladders, RLC ``reshare_bindings`` vs per-dealer Horner
+  (full one-bad-dealer matrix).
+- STRUCTURAL group at big n: the protocol machinery itself — n=64
+  reshare excludes exactly the bad-constant-term dealer, n=48
+  ceremony timelines land in the flight recorder, chunked deal
+  admission still closes the response window under FakeClock, and
+  every rejection is attributable (counter + flight note).
+"""
+
+import asyncio
+
+import pytest
+
+from drand_tpu import metrics
+from drand_tpu.crypto import batch, ecies, endo
+from drand_tpu.crypto.curves import PointG1
+from drand_tpu.crypto.fields import Fp, R
+from drand_tpu.crypto.poly import PriPoly, PubPoly
+from drand_tpu.dkg import DKGConfig, DKGProtocol, LocalBoard
+from drand_tpu.dkg.packets import Deal, DealBundle, Response, ResponseBundle
+from drand_tpu.dkg.packets import STATUS_APPROVAL, STATUS_COMPLAINT
+from drand_tpu.obs.flight import FLIGHT
+from drand_tpu.testing import dkg_scale
+from drand_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _host_mode():
+    """Pin host dispatch: these tests prove host-path bit-identity (the
+    engine KATs cover device-vs-host) and must not kick a backend
+    probe mid-suite."""
+    saved = batch._MODE
+    batch.configure("host")
+    yield
+    batch.configure(saved)
+
+
+def _order3_torsion() -> PointG1:
+    """An explicit order-3 point (clear every factor but one 3 from a
+    full-group point) — the cofactor component the membership check
+    exists to reject. Mirrors crypto/endo._validate_g1."""
+    from drand_tpu.crypto.curves import H1
+
+    for xi in range(1, 64):
+        x = Fp(xi)
+        y = (x.square() * x + PointG1.B).sqrt()
+        if y is None:
+            continue
+        t = PointG1.from_affine(x, y).mul(H1 * R // 3)
+        if not t.is_infinity():
+            return t
+    raise AssertionError("no torsion point found")
+
+
+# ---------------------------------------------------------------------------
+# real crypto: batched verdicts == per-item oracles
+# ---------------------------------------------------------------------------
+
+def test_lockstep_subgroup_check_matches_oracle():
+    g = PointG1.generator()
+    torsion = _order3_torsion()
+    pts = [g.mul(101 + k) for k in range(20)]
+    pts[3] = torsion
+    pts[9] = pts[9] + torsion        # subgroup + torsion mix
+    pts[14] = PointG1.infinity()
+    want = [p.in_subgroup() for p in pts]
+    assert want.count(False) == 2    # the two torsion-tainted lanes
+    assert endo.subgroup_check_fast_g1_many(pts) == want
+    # short list → per-point fast-check path, same oracle
+    small = [g.mul(7), torsion, PointG1.infinity()]
+    assert endo.subgroup_check_fast_g1_many(small) == \
+        [p.in_subgroup() for p in small]
+
+
+def test_parse_commits_matches_sequential_from_bytes():
+    g = PointG1.generator()
+    torsion = _order3_torsion()
+    good = [tuple(g.mul(17 * b + k + 1).to_bytes() for k in range(3))
+            for b in range(5)]
+    bad_encoding = (good[0][0], b"\x00" * 48, good[0][2])
+    bad_subgroup = (good[1][0], torsion.to_bytes(), good[1][2])
+    bundles = [good[0], bad_encoding, good[1], bad_subgroup,
+               good[2], good[3], good[4]]  # 21 points → lockstep path
+
+    def oracle(cs):
+        try:
+            return [PointG1.from_bytes(c, subgroup_check=True) for c in cs]
+        except ValueError:
+            return None
+
+    want = [oracle(cs) for cs in bundles]
+    got = batch.parse_commits(bundles)
+    assert [x is None for x in got] == [x is None for x in want]
+    for gs, ws in zip(got, want):
+        if gs is not None:
+            assert gs == ws
+
+
+def test_share_checks_matches_generator_ladder():
+    g = PointG1.generator()
+    scalars = [5, R - 2, 0x5EED + 7, 1, R + 3]
+    pairs = [(s, g.mul(s % R)) for s in scalars]
+    pairs.append((42, g.mul(43)))  # one wrong expectation
+    want = [g.mul(s % R) == exp for s, exp in pairs]
+    assert want == [True] * 5 + [False]
+    assert batch.share_checks(pairs) == want
+
+
+def test_reshare_bindings_one_bad_dealer_matrix():
+    """RLC 2-MSM verdicts bit-identical to the per-dealer Horner oracle
+    on the all-good case and EVERY single-bad-dealer case (the PR-2
+    bisection-oracle idiom: the combined check must bisect to exactly
+    the poisoned leaf, never an innocent one)."""
+    old = PriPoly([7, 11, 13]).commit()
+    n = 12
+    good = [(i, old.eval(i).value) for i in range(n)]
+    g = PointG1.generator()
+
+    def oracle(items):
+        return [old.eval(i).value == q for i, q in items]
+
+    assert batch._use_rlc(n)  # the path under test
+    assert batch.reshare_bindings(old, good) == [True] * n
+    for bad in range(n):
+        items = list(good)
+        items[bad] = (bad, good[bad][1] + g)
+        want = oracle(items)
+        assert want == [i != bad for i in range(n)]
+        assert batch.reshare_bindings(old, items) == want
+
+
+def test_eval_many_matches_eval():
+    pri = PriPoly([3, 1, 4, 1, 5])
+    idxs = [0, 5, 2, 63, 2]  # duplicates + out-of-order stay aligned
+    assert [(s.index, s.value) for s in pri.eval_many(idxs)] == \
+        [(i, pri.eval(i).value) for i in idxs]
+    pub = pri.commit()
+    assert [(s.index, s.value) for s in pub.eval_many(idxs)] == \
+        [(i, pub.eval(i).value) for i in idxs]
+
+
+# ---------------------------------------------------------------------------
+# structural group at scale
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_structural_ceremony_n48_timeline():
+    n, t = 48, 13
+    FLIGHT.dkg.reset()
+    with dkg_scale.structural_dkg_crypto():
+        res = await dkg_scale.run_ceremony(n, t, nonce=b"zz-cer-48")
+        for r in res:
+            assert r.qual == list(range(n))
+        dkg_scale.check_structural_consistency(res, t)
+    tl = dkg_scale.phase_timeline(mode="dkg")
+    assert set(tl) == {"deal", "response", "finish"}  # no complaints
+    rec = next(r for r in FLIGHT.dkg.sessions() if r["done"])
+    assert rec["qual"] == list(range(n))
+    assert len(rec["bundles"]["deal"]) == n
+    assert rec["rejects"] == []
+    FLIGHT.dkg.reset()
+
+
+@pytest.mark.asyncio
+async def test_structural_reshare_n64_excludes_bad_dealer():
+    """The reshare dual-group binding at n=64: ONE dealer reshares from
+    a corrupted old share (constant term off by one) — the batched
+    binding check excludes exactly that dealer, QUAL keeps everyone
+    else, and the group key is preserved."""
+    n, t = 64, 17
+    FLIGHT.dkg.reset()
+    pairs, nodes = dkg_scale.make_group(n, prefix="zz-rs64")
+    with dkg_scale.structural_dkg_crypto():
+        res = await dkg_scale.run_ceremony(
+            n, t, nonce=b"zz-rs-base", pairs=pairs, nodes=nodes)
+        key = res[0].commits[0]
+        res2 = await dkg_scale.run_reshare(
+            res, pairs, nodes, t_old=t, t_new=t, bad_dealers=(5,))
+        for r in res2:
+            assert 5 not in r.qual
+            assert r.qual == [i for i in range(n) if i != 5]
+        dkg_scale.check_structural_consistency(res2, t, expected_key=key)
+    # the exclusion is attributable: binding_mismatch notes name dealer 5
+    rejects = [x for s in FLIGHT.dkg.sessions() for x in s["rejects"]]
+    assert rejects and all(
+        r["issuer"] == 5 and r["verdict"] == "binding_mismatch"
+        and r["phase"] == "deal" for r in rejects)
+    FLIGHT.dkg.reset()
+
+
+@pytest.mark.asyncio
+async def test_chunked_admission_keeps_phase_window_fakeclock():
+    """Regression for the chunked deal admission (n > _ADMIT_CHUNK →
+    multiple on-loop slices with cooperative yields): with a crashed
+    dealer the phases must still time out and close on the FakeClock —
+    a starved phase clock would wedge the response window open and
+    QUAL would never form."""
+    from drand_tpu.dkg.protocol import _ADMIT_CHUNK
+
+    n, t = 48, 13
+    assert n > _ADMIT_CHUNK
+    FLIGHT.dkg.reset()
+    clock = FakeClock()
+    pairs, nodes = dkg_scale.make_group(n, prefix="zz-fake48")
+    boards = LocalBoard.make_group(n)
+    with dkg_scale.structural_dkg_crypto():
+        configs = [DKGConfig(longterm=pairs[i], nonce=b"zz-fake",
+                             new_nodes=nodes, threshold=t, clock=clock,
+                             phase_timeout=10, seed=b"zz-fake")
+                   for i in range(n - 1)]  # dealer n-1 never runs
+
+        async def drive():
+            # settle to quiescence before each advance: 47 collectors ×
+            # 47 bundles is thousands of loop iterations of sim-instant
+            # work — moving time mid-drain would close the deal window
+            # on a scheduling artifact, not on the protocol
+            for _ in range(10):
+                for _ in range(200):
+                    await clock.settle()
+                await clock.advance(10)
+
+        gathered = asyncio.gather(*(DKGProtocol(c, b).run()
+                                    for c, b in zip(configs, boards)))
+        await asyncio.gather(gathered, drive())
+        res = gathered.result()
+    for r in res:
+        assert r.qual == list(range(n - 1))
+    dkg_scale.check_structural_consistency(res, t)
+    # every retained timeline closed its response window on the clock
+    for rec in FLIGHT.dkg.sessions():
+        resp = [p for p in rec["phases"] if p["phase"] == "response"]
+        assert resp and resp[0]["end_s"] is not None
+    FLIGHT.dkg.reset()
+
+
+# ---------------------------------------------------------------------------
+# attributable rejections
+# ---------------------------------------------------------------------------
+
+def _reject_count(phase: str, verdict: str) -> float:
+    return metrics.DKG_BUNDLE_REJECTS.labels(
+        phase=phase, verdict=verdict)._value.get()
+
+
+@pytest.mark.asyncio
+async def test_deal_rejects_mint_counter_and_flight_note():
+    n, t = 6, 3
+    FLIGHT.dkg.reset()
+    pairs, nodes = dkg_scale.make_group(n, prefix="zz-rej")
+    with dkg_scale.structural_dkg_crypto():
+        conf = DKGConfig(longterm=pairs[0], nonce=b"zz-rej",
+                         new_nodes=nodes, threshold=t, seed=b"zz-rej")
+        proto = DKGProtocol(conf, LocalBoard())
+        proto._sid = FLIGHT.dkg.begin(
+            conf.nonce, mode="dkg", n_dealers=n, n_receivers=n,
+            threshold=t, now=0.0, tag="s0")
+
+        def bundle_from(dealer: int, commits=None, share_val=None):
+            poly = PriPoly([dealer + 2, 9, 4])
+            if commits is None:
+                commits = tuple(c.to_bytes()
+                                for c in poly.commit().commits)
+            val = poly.eval(0).value if share_val is None else share_val
+            deals = (Deal(share_index=0, encrypted_share=ecies.encrypt(
+                nodes[0].identity.key, val.to_bytes(32, "big"))),)
+            return DealBundle(dealer_index=dealer, commits=commits,
+                              deals=deals, session_id=conf.nonce)
+
+        before = {(ph, v): _reject_count(ph, v) for ph, v in
+                  [("deal", "wrong_threshold"), ("deal", "bad_point"),
+                   ("deal", "bad_share"), ("response", "unknown_dealer")]}
+        bundles = [
+            bundle_from(0),                                      # good
+            bundle_from(1, commits=(b"\x00" * 48,) * t),         # bad_point
+            bundle_from(2, commits=(b"junk",) * (t - 1)),  # wrong_threshold
+            bundle_from(3, share_val=12345),                     # bad_share
+        ]
+        await proto._process_deals(bundles)
+        assert set(proto._valid_shares) == {0}
+        assert set(proto._valid_commits) == {0, 3}  # bad share ≠ bad commit
+        proto._process_response(ResponseBundle(
+            share_index=2, responses=(
+                Response(dealer_index=99, status=STATUS_COMPLAINT),
+                Response(dealer_index=0, status=STATUS_APPROVAL)),
+            session_id=conf.nonce), conf.dealers())
+        assert proto._approvals[0] == {2}
+
+    for (ph, v), b in before.items():
+        assert _reject_count(ph, v) == b + 1, (ph, v)
+    rec = next(r for r in FLIGHT.dkg.sessions()
+               if r["session"].endswith("/s0"))
+    got = {(x["phase"], x["issuer"], x["verdict"]) for x in rec["rejects"]}
+    assert got == {("deal", 1, "bad_point"), ("deal", 2, "wrong_threshold"),
+                   ("deal", 3, "bad_share"),
+                   ("response", 2, "unknown_dealer")}
+    FLIGHT.dkg.reset()
+
+
+def test_board_bad_signature_mints_counter():
+    from drand_tpu.dkg.board import BroadcastBoard
+    from drand_tpu.utils.logging import default_logger
+
+    pairs, nodes = dkg_scale.make_group(1, prefix="zz-sig")
+    board = BroadcastBoard(client=None, own_addr=nodes[0].address(),
+                           dealers=nodes, receivers=nodes,
+                           nonce=b"zz-sig", logger=default_logger("t"))
+    bad = DealBundle(dealer_index=0, commits=(b"x" * 48,),
+                     deals=(), session_id=b"zz-sig", signature=b"\x01" * 64)
+    before = _reject_count("deal", "bad_signature")
+    asyncio.run(board._accept(bad, rebroadcast=False))
+    assert _reject_count("deal", "bad_signature") == before + 1
+    assert board.deals.qsize() == 0

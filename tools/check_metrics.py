@@ -133,6 +133,16 @@ KNOWN_LABEL_VALUES = {
     # the spot-check passes, rejected when the signed checkpoint fails
     # verification and the client falls back to the full walk)
     "checkpoint_bootstraps_total": {"result": {"ok", "rejected"}},
+    # large-group ceremonies (ISSUE 19): every phase/verdict pair is
+    # branch-literal at its mint site (dkg/protocol.py verification
+    # paths + dkg/board.py _accept signature check) — a misbehaving
+    # dealer in an n=1024 ceremony is attributable, not silently
+    # dropped
+    "dkg_bundle_rejects_total": {
+        "phase": {"deal", "response", "justification"},
+        "verdict": {"bad_signature", "wrong_threshold", "bad_point",
+                    "binding_mismatch", "bad_share", "unknown_dealer"},
+    },
 }
 
 

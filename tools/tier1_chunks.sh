@@ -49,6 +49,22 @@
 #                          no shared mutable state, no clocks) — they
 #                          coexist in one chunk fine; no pair entry
 #                          needed.
+#   test_zz_dkg_scale.py   large-group ceremony tier: batched-phase
+#                          verdict bit-identity vs per-item oracles
+#                          (lockstep G1 membership, parse_commits,
+#                          comb share checks, RLC reshare bindings),
+#                          structural n=48/64 ceremony + reshare,
+#                          FakeClock chunked-admission regression,
+#                          attributable-reject counters (host-pinned
+#                          by an autouse fixture; real crypto only at
+#                          small n; ~60 s). CONFLICTS evaluation vs
+#                          test_daemon/test_mock_and_scale: runs DKG
+#                          phasers but only on its OWN LocalBoards
+#                          with a private FakeClock (fast-sync
+#                          elsewhere), resets the FLIGHT dkg ring
+#                          around each use and asserts counter
+#                          DELTAS — no shared timers or state; no
+#                          pair entry needed.
 #   test_zz_fanout.py      edge fan-out push tier: SSE/NDJSON hub,
 #                          shedding, segment store, SO_REUSEPORT
 #                          worker smoke (host-only, no pairings except
