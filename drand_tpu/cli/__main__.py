@@ -174,12 +174,21 @@ async def _serve_public(d, listen: str, logger, folder: str,
     tl_service = None
     if timelock:
         # the timelock vault rides the public API by default: pending
-        # ciphertexts persist next to the chain db and reopen on restart
-        from ..timelock import TimelockService, TimelockVault
+        # ciphertexts persist next to the chain db and reopen on
+        # restart. Backend selection (SQLite default, the segment
+        # vault under DRAND_TPU_TIMELOCK_STORE=segment or when the
+        # segment dir already exists) lives in open_vault; the two
+        # backends use sibling paths so neither shadows the other.
+        from ..timelock import TimelockService, open_vault
+        from ..timelock.segvault import is_segment_vault
 
-        db = os.path.join(folder, "db", "timelock.db")
-        os.makedirs(os.path.dirname(db), exist_ok=True)
-        tl_service = TimelockService(TimelockVault(db), client,
+        dbdir = os.path.join(folder, "db")
+        os.makedirs(dbdir, exist_ok=True)
+        seg = os.path.join(dbdir, "timelock-segments")
+        backend = os.environ.get("DRAND_TPU_TIMELOCK_STORE", "").strip()
+        db = (seg if backend == "segment" or is_segment_vault(seg)
+              else os.path.join(dbdir, "timelock.db"))
+        tl_service = TimelockService(open_vault(db), client,
                                      logger=logger.named("timelock"))
         if gateway is not None:
             # non-HTTP clients submit over the public gRPC service:
@@ -796,6 +805,96 @@ def cmd_util(args) -> None:
         print(json.dumps({"reset": True, "removed": removed,
                           "folder": folder}))
         return
+    if args.what == "store-migrate" and args.vault:
+        # Timelock vault SQLite <-> segment (timelock/segvault.py,
+        # ISSUE 20). Daemon/relay must be stopped. Same verified-copy
+        # contract as the chain migration: count + pending_count +
+        # sampled records compared before success is reported.
+        from ..timelock.segvault import (SegmentVault, is_segment_vault,
+                                         migrate_vault)
+        from ..timelock.vault import TimelockVault
+
+        db = args.db or os.path.join(_folder(args), "db", "timelock.db")
+        out = args.out or os.path.join(os.path.dirname(db),
+                                       "timelock-segments")
+        if args.reverse:
+            # the SOURCE must exist in both directions — a typo'd path
+            # would otherwise auto-create an empty vault and report a
+            # successful 0-row migration
+            if not is_segment_vault(out):
+                raise SystemExit(f"no segment vault at {out}")
+            vsrc: object = SegmentVault(out)
+            vdst: object = TimelockVault(db)
+            dst_path = db
+        else:
+            if not os.path.isfile(db):
+                raise SystemExit(f"no timelock db at {db}")
+            vsrc = TimelockVault(db)
+            vdst = SegmentVault(out)
+            dst_path = out
+        # the DESTINATION must be empty: SegmentVault.put_rows has no
+        # duplicate check, so re-running an interrupted migration would
+        # append every row twice — and open_vault auto-selects an
+        # existing segment dir on the next daemon start, serving the
+        # doubled rows. Refuse up front (remove the remnant or point
+        # --out/--db somewhere fresh)
+        if len(vdst) > 0:
+            n_dst = len(vdst)
+            vsrc.close()
+            vdst.close()
+            raise SystemExit(
+                f"destination {dst_path} already holds {n_dst} rows — "
+                f"refusing to append a migration onto it (remove it or "
+                f"choose a fresh path)")
+        n = migrate_vault(vsrc, vdst)
+        problems = []
+        if len(vdst) != len(vsrc):
+            problems.append(f"count mismatch: src={len(vsrc)} "
+                            f"dst={len(vdst)}")
+        if vdst.pending_count() != vsrc.pending_count():
+            problems.append(f"pending mismatch: "
+                            f"src={vsrc.pending_count()} "
+                            f"dst={vdst.pending_count()}")
+        sampled = 0
+        for rec in vsrc.rows():
+            got = vdst.get(rec["id"])
+            src_pt = rec.get("plaintext")
+            dst_pt = got.get("plaintext") if got else None
+            if (got is None
+                    or got["status"] != rec["status"]
+                    or got["round"] != rec["round"]
+                    or (bytes(src_pt) if src_pt else None)
+                    != (bytes(dst_pt) if dst_pt else None)):
+                problems.append(f"record {rec['id']} mismatch")
+                break
+            sampled += 1
+            if sampled >= 64:
+                break
+        pending = vdst.pending_count()
+        vsrc.close()
+        vdst.close()
+        if problems:
+            # quarantine the destination we just wrote (it was empty
+            # before this run): left in place, a half-verified segment
+            # dir would be auto-selected by open_vault on the next
+            # daemon start and served as if it were sound
+            import shutil
+
+            quarantine = dst_path + ".failed"
+            if os.path.isdir(quarantine):
+                shutil.rmtree(quarantine)
+            elif os.path.exists(quarantine):
+                os.remove(quarantine)
+            os.rename(dst_path, quarantine)
+            raise SystemExit("store-migrate --vault verification "
+                             "failed: " + "; ".join(problems)
+                             + f"; destination quarantined at "
+                               f"{quarantine}")
+        print(json.dumps({"migrated": n, "db": db, "segments": out,
+                          "pending": pending,
+                          "direction": ("segment->sqlite" if args.reverse
+                                        else "sqlite->segment")}))
+        return
     if args.what == "store-migrate":
         # SQLite chain db <-> packed segment store (chain/segments.py).
         # Daemon must be stopped. Default direction is sqlite->segment;
@@ -941,11 +1040,23 @@ def cmd_relay(args) -> None:
         tl_service = None
         if args.timelock_db:
             # a relay can front the timelock vault too: it opens rounds
-            # from its verified watch stream (no local chain store)
-            from ..timelock import TimelockService, TimelockVault
+            # from its verified watch stream (no local chain store).
+            # --timelock-shard i/K (set by the worker parent under the
+            # segment backend) partitions the sweep: this worker opens
+            # ONLY its token-range slice and appends under its own
+            # writer id, so K workers sharing one vault never
+            # interleave writes (timelock/segvault.py shard math)
+            from ..timelock import TimelockService, open_vault
 
-            tl_service = TimelockService(TimelockVault(args.timelock_db),
-                                         client)
+            shard = None
+            writer_id = 0
+            if args.timelock_shard:
+                idx, _, count = args.timelock_shard.partition("/")
+                shard = (int(idx), int(count))
+                writer_id = shard[0]
+            tl_service = TimelockService(
+                open_vault(args.timelock_db, writer_id=writer_id),
+                client, shard=shard)
         server = PublicServer(
             client, timelock_service=tl_service,
             timelock_sweep=not args.no_timelock_sweep)
@@ -995,9 +1106,29 @@ def _relay_parent(args) -> None:
         argv += ["--insecure"]
     if args.timelock_db:
         argv += ["--timelock-db", args.timelock_db]
-    def _spawn(sweeper: bool):
+
+    # Partitioned sweeps (ISSUE 20): under the SEGMENT vault backend
+    # every worker sweeps its own disjoint token-range shard (and
+    # appends under its own writer id — no interleaved writes on the
+    # shared directory), so a round's K·ceil(n/K) openings spread
+    # across all cores instead of serializing on one sweeper. The
+    # SQLite backend keeps the sole-sweeper designation: K concurrent
+    # sweeps there would contend on one WAL file every round.
+    partitioned = False
+    if args.timelock_db:
+        from ..timelock.segvault import is_segment_vault
+
+        backend = os.environ.get(
+            "DRAND_TPU_TIMELOCK_STORE", "").strip()
+        partitioned = (backend == "segment"
+                       or is_segment_vault(args.timelock_db))
+
+    def _spawn(slot: int):
         worker_argv = list(argv)
-        if args.timelock_db and not sweeper:
+        if partitioned:
+            worker_argv += ["--timelock-shard",
+                            f"{slot}/{args.workers}"]
+        elif args.timelock_db and slot != 0:
             # ONE designated sweeping worker: all workers serve the
             # vault routes from the shared file, but only the sweeper
             # opens rounds at boundaries — K concurrent sweeps would
@@ -1006,12 +1137,14 @@ def _relay_parent(args) -> None:
             worker_argv.append("--no-timelock-sweep")
         return subprocess.Popen(worker_argv)
 
-    procs = [_spawn(sweeper=(i == 0)) for i in range(args.workers)]
-    sweeper = procs[0]
+    slots = [_spawn(i) for i in range(args.workers)]
+    procs = list(slots)
+    sweeper = slots[0]
     crashed = False
     stopping = False
     print(f"relay parent pid={os.getpid()} workers="
-          f"{[p.pid for p in procs]}", flush=True)
+          f"{[p.pid for p in procs]}"
+          + (" partitioned" if partitioned else ""), flush=True)
 
     def _fan_out(signum, frame):
         nonlocal stopping
@@ -1025,25 +1158,56 @@ def _relay_parent(args) -> None:
 
     # a dead SWEEPER would silently stop vault round-opens while the
     # survivors keep serving — respawn it through the shared bounded
-    # supervisor (a crash-looping sweeper must not fork-bomb the box)
+    # supervisor (a crash-looping sweeper must not fork-bomb the box).
+    # Partitioned mode widens this to EVERY worker: each owns a token
+    # shard, so any death leaves a slice of every round unopened —
+    # the respawn carries the slot's shard assignment over.
+    sup = Supervisor(respawn_budget=5, backoff_base_s=0.0)
+
     def _respawn_sweeper() -> None:
         nonlocal sweeper, crashed
         old_rc = sweeper.returncode
         crashed = crashed or old_rc != 0
-        sweeper = _spawn(sweeper=True)
+        sweeper = _spawn(0)
+        slots[0] = sweeper
         procs.append(sweeper)
         print(f"relay parent: sweeper died (rc={old_rc}), "
               f"respawned pid={sweeper.pid} "
               f"({sup.respawns('sweeper')}/{sup.respawn_budget})",
               flush=True)
 
-    sup = Supervisor(respawn_budget=5, backoff_base_s=0.0)
-    sup.register("sweeper", is_alive=lambda: sweeper.poll() is None,
-                 respawn=_respawn_sweeper)
+    def _mk_respawn_shard(slot: int):
+        def _respawn() -> None:
+            nonlocal crashed
+            old_rc = slots[slot].returncode
+            crashed = crashed or old_rc != 0
+            p = _spawn(slot)
+            slots[slot] = p
+            procs.append(p)
+            print(f"relay parent: shard {slot}/{args.workers} worker "
+                  f"died (rc={old_rc}), respawned pid={p.pid} "
+                  f"({sup.respawns(f'shard-{slot}')}/"
+                  f"{sup.respawn_budget})", flush=True)
+        return _respawn
+
+    names: list[str] = []
+    if partitioned:
+        for i in range(args.workers):
+            name = f"shard-{i}"
+            sup.register(name,
+                         is_alive=lambda i=i: slots[i].poll() is None,
+                         respawn=_mk_respawn_shard(i))
+            names.append(name)
+    else:
+        sup.register("sweeper",
+                     is_alive=lambda: sweeper.poll() is None,
+                     respawn=_respawn_sweeper)
+        names.append("sweeper")
     while any(p.poll() is None for p in procs):
         if (args.timelock_db and not stopping
                 and any(p.poll() is None for p in procs)):
-            sup.maybe_respawn("sweeper")
+            for name in names:
+                sup.maybe_respawn(name)
         _time.sleep(0.2)
     # any worker that did not exit cleanly — including signal deaths,
     # whose returncode is NEGATIVE — must surface to the supervisor;
@@ -1456,6 +1620,11 @@ def main(argv=None) -> None:
     u.add_argument("--reverse", action="store_true",
                    help="store-migrate: convert segment->sqlite "
                         "instead of sqlite->segment")
+    u.add_argument("--vault", action="store_true",
+                   help="store-migrate: convert the TIMELOCK vault "
+                        "(default <folder>/db/timelock.db <-> "
+                        "<db dir>/timelock-segments) instead of the "
+                        "chain store; honors --db/-o/--reverse")
     u.add_argument("--json", action="store_true",
                    help="raw JSON instead of the pretty rendering "
                         "(trace/engine/flight)")
@@ -1501,6 +1670,8 @@ def main(argv=None) -> None:
                    help=argparse.SUPPRESS)  # set by the worker parent
     r.add_argument("--no-timelock-sweep", action="store_true",
                    help=argparse.SUPPRESS)  # parent designates sweeper
+    r.add_argument("--timelock-shard", default="",
+                   help=argparse.SUPPRESS)  # parent assigns "i/K" shard
     r.set_defaults(fn=cmd_relay)
 
     tl = sub.add_parser("timelock",

@@ -85,6 +85,42 @@ class HTTPClient(Client):
         return checkpoint_from_json(
             await self._get_json("/checkpoints/latest"))
 
+    async def get_span(self, lo: int, hi: int) -> list:
+        """Bulk catch-up fast path over the wire: ``[lo, hi)`` as
+        Beacons via ``GET /public/span`` (the VerifyingClient's chunk
+        fetch — one request per server span-cap page instead of one
+        per round). Validates length and the per-position round echo;
+        raises ClientError unless the WHOLE span is served (matching
+        DirectClient.get_span — the catch-up walk needs contiguous
+        windows)."""
+        from ..chain.beacon import Beacon
+
+        if hi <= lo:
+            return []
+        out: list = []
+        rn = lo
+        while rn < hi:
+            body = await self._get_json(
+                f"/public/span?from={rn}&count={hi - rn}")
+            beacons = body.get("beacons") or []
+            if not beacons:
+                raise ClientError(
+                    f"span [{rn}, {hi}): server returned no beacons")
+            for d in beacons:
+                r = result_from_json(d)
+                if r.round != rn:
+                    raise ClientError(
+                        f"span position {rn} carried round {r.round}")
+                out.append(Beacon(
+                    round=r.round, previous_sig=r.previous_signature,
+                    signature=r.signature,
+                    signature_v2=r.signature_v2))
+                rn += 1
+                if rn > hi:
+                    raise ClientError(
+                        f"span [{lo}, {hi}): server overshot to {rn}")
+        return out
+
     async def watch(self):
         """Poll for each upcoming round (client/http/poll.go:13): sleep to
         the next round boundary, then long-poll GET it."""

@@ -502,24 +502,44 @@ def aggregate_round(pub_poly: PubPoly, msg: bytes, partials, t: int, n: int,
         return oks, sig
 
 
-def decrypt_round_batch(signature, cts) -> list[tuple[bool, bytes, str]]:
+def decrypt_round_batch(signature, cts,
+                        chunk: int | None = None
+                        ) -> list[tuple[bool, bytes, str]]:
     """Open ALL of a round's timelock ciphertexts against its V2
     signature in one batched dispatch — the vault's round-boundary hot
     call (drand_tpu/timelock). Returns ``(ok, plaintext, error)`` per
     ciphertext, aligned with ``cts``, never raising per item.
 
-    Device tier: ONE batched GT dispatch (ops/engine.timelock_open —
-    the Miller line computation over the shared signature runs once, K
-    varying U points on the batch axis) under
-    ``engine_op_seconds{op="timelock", path="device"}``; a KAT-gate
-    failure falls back to the host tier with a fallback-ledger entry.
-    Host tier: the shared-signature batch decryptor
-    (crypto/timelock.decrypt_batch) under ``path="host_shared"`` — the
-    per-round line precomputation is hoisted, outcomes bit-identical to
-    a per-item ``timelock.decrypt`` loop. The Fujisaki-Okamoto check is
-    host-exact on BOTH tiers."""
+    ``chunk`` is the open budget (ISSUE 20 bounded boundary opens): a
+    positive value splits the K axis into ceil(K/chunk) independent
+    dispatches — the shared-signature work re-amortizes inside each
+    chunk, so the split is embarrassing. ``None`` reads the
+    ``DRAND_TPU_TIMELOCK_OPEN_CHUNK`` default (unset/0 = one
+    dispatch). The timelock service pre-chunks at this budget itself
+    (it needs a vault commit between chunks) and hands each slice down
+    with ``chunk=0``; direct callers get the same bound here.
+
+    Device tier: ONE batched GT dispatch per chunk
+    (ops/engine.timelock_open — the Miller line computation over the
+    shared signature runs once, K varying U points on the batch axis)
+    under ``engine_op_seconds{op="timelock", path="device"}``; a
+    KAT-gate failure falls back to the host tier with a
+    fallback-ledger entry. Host tier: the shared-signature batch
+    decryptor (crypto/timelock.decrypt_batch) under
+    ``path="host_shared"`` — the per-round line precomputation is
+    hoisted, outcomes bit-identical to a per-item ``timelock.decrypt``
+    loop. The Fujisaki-Okamoto check is host-exact on BOTH tiers."""
     from . import timelock
 
+    if chunk is None:
+        chunk = int(os.environ.get("DRAND_TPU_TIMELOCK_OPEN_CHUNK",
+                                   "0") or 0)
+    if chunk and chunk > 0 and len(cts) > chunk:
+        out: list[tuple[bool, bytes, str]] = []
+        for base in range(0, len(cts), chunk):
+            out.extend(decrypt_round_batch(
+                signature, cts[base:base + chunk], chunk=0))
+        return out
     n = len(cts)
     if n and _use_device(n):
         try:

@@ -69,14 +69,18 @@ class Subscription:
     prepared. ``None`` from :meth:`next` means the stream is over —
     the hub shed this subscriber or the server is draining."""
 
-    __slots__ = ("proto", "_queue", "shed")
+    __slots__ = ("proto", "_queue", "shed", "token")
 
-    def __init__(self, proto: str, queue_max: int):
+    def __init__(self, proto: str, queue_max: int,
+                 token: str | None = None):
         self.proto = proto
         # asyncio.Queue(0) means UNBOUNDED — exactly the failure mode
         # this hub exists to rule out; clamp to at least one slot
         self._queue: asyncio.Queue = asyncio.Queue(max(1, queue_max))
         self.shed = False
+        # open-notify filter (TimelockNotifyHub): only events for this
+        # ciphertext id reach the queue; None = the firehose
+        self.token = token
 
     async def next(self) -> tuple[int, bytes] | None:
         return await self._queue.get()
@@ -177,3 +181,119 @@ class FanoutHub:
             sub._close()
         self._subs.clear()
         metrics.RELAY_WATCHERS.set(0)
+
+
+class TimelockNotifyHub:
+    """Open-notify leg on the fan-out model (ISSUE 20): "tell me when
+    MY ciphertext opens" without 100k watchers polling
+    ``GET /timelock/{id}``. The timelock service pushes
+    ``(token, status, round)`` after each chunk's vault COMMITS, so a
+    subscriber that re-fetches the status route on notify always sees
+    the decided, immutable row.
+
+    Same discipline as :class:`FanoutHub` — single-threaded on the
+    serving loop, bounded per-connection queues, slow consumers shed
+    (``relay_shed_total{reason="timelock_slow"}``) — but delivery is
+    token-KEYED: a subscription watching one id only ever receives that
+    id's event (most watchers see exactly one frame, then the stream
+    ends). A token-less subscription is the firehose: every decided
+    ciphertext, for operators watching a sweep drain."""
+
+    def __init__(self, queue_max: int = DEFAULT_QUEUE_MAX):
+        self._queue_max = queue_max
+        self._by_token: dict[str, set[Subscription]] = {}
+        self._firehose: set[Subscription] = set()
+        self.publishes = 0  # decided-ciphertext events published
+
+    # --------------------------------------------------------- membership
+    def watcher_count(self) -> int:
+        return (sum(len(s) for s in self._by_token.values())
+                + len(self._firehose))
+
+    def subscribe(self, proto: str,
+                  token: str | None = None) -> Subscription:
+        sub = Subscription(proto, self._queue_max, token=token)
+        if token is None:
+            self._firehose.add(sub)
+        else:
+            self._by_token.setdefault(token, set()).add(sub)
+        self._gauge()
+        return sub
+
+    def unsubscribe(self, sub: Subscription) -> None:
+        if sub.token is None:
+            self._firehose.discard(sub)
+        else:
+            subs = self._by_token.get(sub.token)
+            if subs is not None:
+                subs.discard(sub)
+                if not subs:
+                    del self._by_token[sub.token]
+        self._gauge()
+
+    def _gauge(self) -> None:
+        from .. import metrics
+
+        metrics.TIMELOCK_WATCHERS.set(self.watcher_count())
+
+    # ---------------------------------------------------------- publishing
+    def publish_open(self, events: list[tuple[str, str, int]]) -> int:
+        """Push a committed chunk's decided ciphertexts to whoever is
+        watching them: ``(token, status, round)`` per event. Framing is
+        per event + protocol (events go to DIFFERENT subscribers, so
+        there is no shared payload to amortize the way round fan-out
+        has); per-event cost without watchers is two dict probes.
+        Returns the number of subscribers reached."""
+        from .. import metrics
+
+        reached = 0
+        shed: list[Subscription] = []
+        for token, status, round_no in events:
+            self.publishes += 1
+            if status == "opened":
+                metrics.TIMELOCK_NOTIFY.labels(event="opened").inc()
+            else:
+                metrics.TIMELOCK_NOTIFY.labels(event="rejected").inc()
+            watchers = self._by_token.get(token)
+            if not watchers and not self._firehose:
+                continue
+            payload = json.dumps({"id": token, "status": status,
+                                  "round": round_no}).encode()
+            frames: dict[str, bytes] = {}
+            targets = list(watchers or ())
+            targets.extend(self._firehose)
+            for sub in targets:
+                if sub.shed:
+                    # shed by an EARLIER event in this batch (its slot
+                    # already holds the close sentinel) — one shed, one
+                    # counter increment, per connection
+                    continue
+                frame = frames.get(sub.proto)
+                if frame is None:
+                    frame = (sse_frame(round_no, payload)
+                             if sub.proto == PROTO_SSE
+                             else ndjson_frame(payload))
+                    frames[sub.proto] = frame
+                if sub._push((round_no, frame)):
+                    reached += 1
+                else:
+                    sub.shed = True
+                    sub._close()
+                    shed.append(sub)
+                    metrics.RELAY_SHED.labels(
+                        reason="timelock_slow").inc()
+        for sub in shed:
+            self.unsubscribe(sub)
+        return reached
+
+    def close_all(self) -> None:
+        from .. import metrics
+
+        for subs in list(self._by_token.values()):
+            for sub in list(subs):
+                sub._close()
+        for sub in list(self._firehose):
+            sub._close()
+        self._by_token.clear()
+        self._firehose.clear()
+        metrics.TIMELOCK_WATCHERS.set(0)

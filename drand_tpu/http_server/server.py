@@ -54,6 +54,11 @@ DEFAULT_MAX_WATCHERS = int(os.environ.get(
 # pollers that tolerate staleness
 POSTURE_WATCHER_FRACTION = 0.5
 
+# /public/span page cap: one request serves at most this many beacons
+# (the adaptive RLC catch-up client pages through larger windows) —
+# bounds per-request memory and upstream fan-in on the serving side
+DEFAULT_SPAN_CAP = int(os.environ.get("DRAND_TPU_SPAN_CAP", "1024"))
+
 
 def _etag_matches(if_none_match: str | None, etag: str) -> bool:
     """RFC 7232 If-None-Match: member-wise WEAK comparison — caches
@@ -111,6 +116,14 @@ class PublicServer:
         # avoids K workers re-opening the same rounds concurrently)
         self._timelock = timelock_service
         self._timelock_sweep = timelock_sweep
+        # multi-worker open-notify fallback: when the open for a
+        # watched token commits in ANOTHER worker process (the sole
+        # sweeper under the shared-SQLite mode, the shard owner under
+        # partitioned segment sweeps), this worker's hub never
+        # publishes it — the watch handler polls the SHARED vault at
+        # this interval instead of hanging forever
+        self._tl_watch_poll = float(os.environ.get(
+            "DRAND_TPU_TIMELOCK_WATCH_POLL") or 2.0)
         self._latest: Result | None = None
         self._next_round_event = asyncio.Event()
         self._watch_task: asyncio.Task | None = None
@@ -120,6 +133,7 @@ class PublicServer:
         self._hub = fanout.FanoutHub(queue_max=fanout_queue_max)
         self._max_watchers = (max_watchers if max_watchers is not None
                               else DEFAULT_MAX_WATCHERS)
+        self._span_cap = DEFAULT_SPAN_CAP
         # partition posture (ISSUE 16): applied by the remediation
         # engine on a majority reachability drop, reverted on incident
         # close — serve stale from the cache without hammering the dead
@@ -132,6 +146,7 @@ class PublicServer:
         self.app = web.Application(middlewares=[self._instrument])
         self.app.add_routes([
             web.get("/public/latest", self._handle_latest),
+            web.get("/public/span", self._handle_span),
             web.get("/public/{round}", self._handle_round),
             web.get("/info", self._handle_info),
             web.get("/checkpoints/latest", self._handle_checkpoint),
@@ -141,9 +156,15 @@ class PublicServer:
             web.get("/metrics", self._handle_metrics),
             web.get("/peer/{addr}/metrics", self._handle_peer_metrics),
         ])
+        # open-notify leg (ISSUE 20): GET /timelock with a stream Accept
+        # pushes (token, status) at open time — wired as the service's
+        # notifier so events fire right after each chunk's vault commit
+        self._tl_hub = fanout.TimelockNotifyHub(queue_max=fanout_queue_max)
         if timelock_service is not None:
+            timelock_service.set_notifier(self._tl_hub.publish_open)
             self.app.add_routes([
                 web.post("/timelock", self._handle_timelock_submit),
+                web.get("/timelock", self._handle_timelock_watch),
                 web.get("/timelock/{id}", self._handle_timelock_status),
             ])
         # the round-timeline surface is on by default (no profiling
@@ -189,6 +210,7 @@ class PublicServer:
         # in-flight submit against a closed sqlite handle would 500
         # instead of being refused cleanly
         self._hub.close_all()
+        self._tl_hub.close_all()
         await self._runner.cleanup()
         if self._timelock is not None:
             await self._timelock.close()
@@ -508,6 +530,67 @@ class PublicServer:
         except ClientError as e:
             return web.json_response({"error": str(e)}, status=404)
 
+    async def _handle_span(self, request: web.Request) -> web.Response:
+        """GET /public/span?from=&count=: a contiguous beacon window in
+        one request — the wire surface for the adaptive RLC catch-up
+        fast path (client/verify.py span batches, ROADMAP #7). Serves
+        at most DRAND_TPU_SPAN_CAP beacons per request (the client
+        pages); a partially available window returns its PREFIX, so the
+        caller always makes progress and retries the rest. 404 when the
+        first round is not servable at all."""
+        from ..client.interface import result_from_beacon
+
+        try:
+            frm = int(request.query.get("from", ""))
+            count = int(request.query.get("count", ""))
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": "span needs integer from= and count="},
+                status=400)
+        if frm < 1 or count < 1:
+            return web.json_response(
+                {"error": "span needs from >= 1 and count >= 1"},
+                status=400)
+        capped = min(count, self._span_cap)
+        results: list[Result] = []
+        get_span = getattr(self._client, "get_span", None)
+        if get_span is not None:
+            # bulk path (DirectClient over the local store): all-or-
+            # nothing, so fall through to the prefix loop on a miss
+            try:
+                results = [result_from_beacon(b)
+                           for b in await get_span(frm, frm + capped)]
+            except ClientError:
+                results = []
+        if not results:
+            for rn in range(frm, frm + capped):
+                try:
+                    results.append(await self._client.get(rn))
+                except ClientError:
+                    break
+        if not results:
+            return web.json_response(
+                {"error": "span not available"}, status=404)
+        # server-side round echo: a confused upstream must never ship
+        # a window whose positions disagree with the request
+        for i, r in enumerate(results):
+            if r.round != frm + i:
+                return web.json_response(
+                    {"error": f"upstream served round {r.round} at "
+                              f"position {frm + i}"}, status=502)
+        resp = web.json_response({
+            "from": frm, "count": len(results),
+            "beacons": [result_json(r) for r in results]})
+        if len(results) == capped:
+            # every requested round exists: beacons are immutable, the
+            # window can never change — CDN-cacheable like /public/{n}
+            resp.headers["ETag"] = f'"span-{frm}-{len(results)}"'
+            resp.headers["Cache-Control"] = \
+                "public, max-age=31536000, immutable"
+        else:
+            resp.headers["Cache-Control"] = "no-store"
+        return resp
+
     async def _handle_info(self, request: web.Request) -> web.Response:
         try:
             info = await self._get_info()
@@ -653,6 +736,107 @@ class PublicServer:
         resp.headers["ETag"] = etag
         resp.headers["Cache-Control"] = \
             "public, max-age=31536000, immutable"
+        return resp
+
+    @staticmethod
+    def _tl_frame(proto: str, rec: dict) -> bytes:
+        """One open-notify frame from a status record."""
+        payload = json.dumps({"id": rec["id"], "status": rec["status"],
+                              "round": rec["round"]}).encode()
+        return (fanout.sse_frame(rec["round"], payload)
+                if proto == fanout.PROTO_SSE
+                else fanout.ndjson_frame(payload))
+
+    async def _handle_timelock_watch(self, request: web.Request
+                                     ) -> web.StreamResponse:
+        """GET /timelock (stream Accept): open-notify push — "tell me
+        when my ciphertext opens" (``?id=<token>``) without polling
+        ``GET /timelock/{id}``; the frame is ``{"id","status","round"}``
+        and a token-scoped stream ENDS after delivering its event (the
+        row is immutable — there is nothing more to say). Without
+        ``?id=`` the stream is the firehose: every decided ciphertext
+        THIS worker opens (the firehose is per-process — on a
+        multi-worker relay an operator watching a partitioned sweep
+        drain should tail each worker, or poll pending_count).
+        Shedding (429 at the shared watcher cap, disconnect on queue
+        overflow) and protocol negotiation are inherited from the
+        /public/latest push tier.
+
+        Multi-worker delivery: a ``?id=`` watcher's connection lands on
+        an ARBITRARY worker (SO_REUSEPORT), but the open for its token
+        commits in exactly one — the sole sweeper (shared-SQLite mode)
+        or the shard owner (partitioned segment mode). When this worker
+        is not that one, the hub wait is backstopped by polling the
+        SHARED vault every ``DRAND_TPU_TIMELOCK_WATCH_POLL`` seconds
+        (decided rows are visible to every worker through the shared
+        store), so the watcher is notified within one poll interval of
+        the commit instead of hanging forever."""
+        proto = self._stream_proto(request)
+        if proto is None:
+            return web.json_response(
+                {"error": "stream endpoint: set Accept: "
+                          "text/event-stream or application/x-ndjson "
+                          "(POST submits a ciphertext)"}, status=400)
+        # both stream legs share one fd budget — the cap is per worker,
+        # not per endpoint
+        if (self._hub.watcher_count()
+                + self._tl_hub.watcher_count()) >= self._max_watchers:
+            return self._shed_response()
+        token = request.query.get("id")
+        poll = None
+        if token is not None and not (
+                self._timelock_sweep
+                and self._timelock.opens_locally(token)):
+            poll = self._tl_watch_poll
+        resp = web.StreamResponse()
+        resp.headers["Content-Type"] = (
+            "text/event-stream" if proto == fanout.PROTO_SSE
+            else "application/x-ndjson")
+        resp.headers["Cache-Control"] = "no-store"
+        resp.headers["Vary"] = "Accept"
+        resp.headers["X-Accel-Buffering"] = "no"
+        resp.headers["X-Drand-Worker"] = str(os.getpid())
+        # subscribe BEFORE the snapshot probe: an open committing
+        # between the two lands either in the snapshot or the queue,
+        # never in neither
+        sub = self._tl_hub.subscribe(proto, token)
+        try:
+            await resp.prepare(request)
+            if token is not None:
+                rec = await self._timelock.status(token)
+                if rec is not None and rec["status"] != "pending":
+                    await resp.write(self._tl_frame(proto, rec))
+                    await resp.write_eof()
+                    return resp
+            while True:
+                if poll is None:
+                    item = await sub.next()
+                else:
+                    # another process owns this token's open: race the
+                    # (possible, if an opportunistic local sweep gets
+                    # there first) hub event against a shared-vault
+                    # poll — whichever decides first ends the stream.
+                    # The poll also self-heals a lost hub wakeup.
+                    try:
+                        item = await asyncio.wait_for(sub.next(),
+                                                      timeout=poll)
+                    except asyncio.TimeoutError:
+                        rec = await self._timelock.status(token)
+                        if rec is None or rec["status"] == "pending":
+                            continue
+                        item = (rec["round"],
+                                self._tl_frame(proto, rec))
+                if item is None:
+                    break  # shed as a slow consumer, or server drain
+                _, frame = item
+                await resp.write(frame)
+                if sub.token is not None:
+                    break  # the one event this watcher waited for
+            await resp.write_eof()
+        except (ConnectionResetError, ConnectionError):
+            pass  # the client went away mid-stream; nothing to salvage
+        finally:
+            self._tl_hub.unsubscribe(sub)
         return resp
 
     async def _handle_readyz(self, request: web.Request) -> web.Response:

@@ -47,6 +47,15 @@ Timelock serving tier (drand_tpu/timelock, ISSUE 9):
   timelock_ciphertexts_total{result}   [private] vault lifecycle counter
       (submitted | opened | rejected); round-open latency rides
       engine_op_seconds{op="timelock", path=device|host_shared}
+  timelock_open_dispatches_total       [private] chunked boundary-open
+      dispatches — ceil(K/DRAND_TPU_TIMELOCK_OPEN_CHUNK) per round of K
+      pending ciphertexts (ISSUE 20 bounded opens)
+  timelock_sweep_shards                [private] token-range shards the
+      boundary sweep partitions over (1 = sole sweeper, K = one of a
+      relay --workers K group each opening a disjoint shard)
+  vault_reads_total{backend}           [private] vault record reads by
+      backend (sqlite | segment) — segment-vault migration
+      observability (ISSUE 20)
 Chain-health / SLO set (obs/health.py, ISSUE 6 — fed by the
 DiscrepancyStore on every stored beacon and re-evaluated by /healthz):
   beacon_round_lateness_seconds        [group]   actual emit time vs the
@@ -154,7 +163,14 @@ chain store behind it):
       refused or dropped by the load shedder (watcher_cap = 429 at the
       connection cap with Retry-After on the next round boundary;
       slow_consumer = bounded send queue overflowed, the stream was
-      disconnected rather than buffered unboundedly)
+      disconnected rather than buffered unboundedly; timelock_slow =
+      the same queue overflow on the /timelock open-notify leg)
+  timelock_watchers                    [http]    currently connected
+      /timelock open-notify stream watchers (SSE + NDJSON) on this
+      worker (ISSUE 20)
+  timelock_notify_total{event}         [http]    decided-ciphertext
+      events pushed on the /timelock leg (opened | rejected), once per
+      ciphertext regardless of watcher count
   relay_boundary_delivery_seconds      [http]    scheduled round
       boundary to hub publish on this worker — the server half of
       boundary-to-delivery latency (the bench measures the client half)
@@ -305,6 +321,25 @@ TIMELOCK_CIPHERTEXTS = Counter(
     "accepted into the vault; opened = decrypted at the round boundary; "
     "rejected = failed the Fujisaki-Okamoto check or could never open)",
     ["result"], registry=REGISTRY)
+TIMELOCK_OPEN_DISPATCHES = Counter(
+    "timelock_open_dispatches_total",
+    "Chunked round-boundary open dispatches — one shared-signature "
+    "batched decrypt per chunk of at most DRAND_TPU_TIMELOCK_OPEN_CHUNK "
+    "pending ciphertexts, so a round of K opens in ceil(K/chunk) "
+    "dispatches with a vault commit and a cooperative yield after each",
+    registry=REGISTRY)
+TIMELOCK_SWEEP_SHARDS = Gauge(
+    "timelock_sweep_shards",
+    "Token-range shard count this worker's boundary sweep partitions "
+    "over (1 = sole sweeper; K = one of a relay --workers K group, "
+    "each opening a disjoint token shard of every round)",
+    registry=REGISTRY)
+VAULT_READS = Counter(
+    "vault_reads_total",
+    "Timelock vault record reads (status lookups and submit "
+    "idempotency probes) by backend (sqlite|segment) — the migration "
+    "observability for the segment vault format",
+    ["backend"], registry=REGISTRY)
 
 # ---- round tracing (obs/trace.py) -----------------------------------------
 # Stage/op work spans sub-millisecond (host crypto on small groups) to
@@ -509,8 +544,20 @@ RELAY_SHED = Counter(
     "Stream watchers refused or dropped by the load shedder "
     "(watcher_cap = 429 at the connection cap, Retry-After on the next "
     "round boundary; slow_consumer = bounded send queue overflowed and "
-    "the stream was disconnected)",
+    "the stream was disconnected; timelock_slow = same overflow on the "
+    "/timelock open-notify leg)",
     ["reason"], registry=HTTP_REGISTRY)
+TIMELOCK_WATCHERS = Gauge(
+    "timelock_watchers",
+    "Currently connected /timelock open-notify stream watchers "
+    "(SSE + NDJSON) on this worker",
+    registry=HTTP_REGISTRY)
+TIMELOCK_NOTIFY = Counter(
+    "timelock_notify_total",
+    "Open-notify events published on the /timelock stream leg by "
+    "terminal status (opened|rejected) — counted once per decided "
+    "ciphertext, not per watcher",
+    ["event"], registry=HTTP_REGISTRY)
 RELAY_BOUNDARY_DELIVERY = Histogram(
     "relay_boundary_delivery_seconds",
     "Scheduled round boundary to fan-out hub publish on this worker "

@@ -10,6 +10,11 @@ tier otherwise).
 
 - :class:`TimelockVault` (vault.py): the persistent store — the
   chain/store.py single-writer SQLite pattern, surviving daemon restart.
+- :class:`SegmentVault` (segvault.py, ISSUE 20): the planet-scale
+  backend — per-round segment files with fixed-width records, an O(1)
+  token index and counter-backed ``pending_count``; opt-in via
+  ``DRAND_TPU_TIMELOCK_STORE=segment``, convertible both ways with
+  ``util store-migrate --vault``.
 - :class:`TimelockService` (service.py): submit validation, the
   round-boundary open (hooked off the DiscrepancyStore
   ``note_round_complete`` path AND the PublicServer watch loop, so both
@@ -21,7 +26,9 @@ tier otherwise).
 """
 
 from .vault import TimelockVault, VaultError
+from .segvault import SegmentVault, migrate_vault, open_vault
 from .service import TimelockService, TimelockError, note_round_complete
 
-__all__ = ["TimelockVault", "VaultError", "TimelockService",
+__all__ = ["TimelockVault", "VaultError", "SegmentVault",
+           "migrate_vault", "open_vault", "TimelockService",
            "TimelockError", "note_round_complete"]

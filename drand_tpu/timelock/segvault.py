@@ -1,0 +1,932 @@
+"""Segment-backed timelock vault: planet-scale write-once rows.
+
+The SQLite vault (vault.py) is perfect for a demo backlog and falls
+over at 10M rows exactly where the beacon store did at 1M rounds
+(chain/segments.py, PR 14): ``pending_count()`` becomes an index scan
+that runs on EVERY submit, and ``status(token)`` walks a multi-GB
+B-tree. Timelock rows have the same shape that made segments work for
+beacons — write-once, append-mostly, immutable once decided — so the
+same record/epoch layout applies:
+
+``<dir>/meta.json``                 version guard
+``<dir>/w<NN>/``                    one directory per WRITER (see below)
+``    rounds/r-<round>.idx``        fixed 64-byte records, one per row
+``    rounds/r-<round>.dat``        append-only envelope JSON blobs
+``    rounds/r-<round>.out``        append-only outcome blobs (plaintext
+                                    or reject error) written by THIS
+                                    writer acting as the OPENER — the
+                                    flipped idx entry may live in
+                                    another writer's file
+``    rounds/r-<round>.done``       marker: total entry count across all
+                                    writers when the round fully decided
+                                    (stale the moment a later submit
+                                    grows the total — compared, never
+                                    trusted blindly)
+``    index.tbl``                   open-addressing token hash (a HINT:
+                                    every candidate is verified against
+                                    the full 16-byte token in the idx
+                                    record; rebuilt from idx files when
+                                    torn)
+``    counters.bin``                24 bytes: submitted/opened/rejected
+                                    totals for THIS writer's operations
+
+Writers: multi-worker relays sharing one ``--timelock-db`` under
+``relay --workers K`` each construct ``SegmentVault(path, writer_id=i)``
+and append ONLY inside their own ``w<NN>/`` directory — no two
+processes ever append to the same file, which is what makes the shared
+vault safe without cross-process locking. Everyone READS every writer's
+files; the only cross-writer WRITE is the entry flip in
+:meth:`finish_round` (a 64-byte pwrite at a fixed offset — disjoint
+offsets per row, and only this worker's token shard flips here, so two
+sweepers never race one entry). Two processes claiming the SAME
+``writer_id`` would interleave appends and corrupt that directory —
+the relay parent hands each worker a distinct shard index.
+
+O(1)-at-depth: ``status(token)`` is one hash probe + one 64-byte pread;
+``pending_count()`` sums three counters per writer (no scan). Counter
+drift after a crash between an append and its counter write is bounded
+by the in-flight batch and self-heals as those rows decide; the
+authoritative state is always the idx records.
+
+Durability matches segments.py: raw-fd writes reach the OS per
+operation (no user-space buffering), no fsync — a crash can lose the
+last instants of writes but never corrupts earlier records, and a row
+whose hash insert was lost is still found by the sweep (idx scan) and
+re-indexed when it decides.
+"""
+
+from __future__ import annotations
+
+import json
+import mmap
+import os
+import struct
+import threading
+import time
+
+from .vault import TimelockVault, VaultError
+
+META_FILE = "meta.json"
+_META_VERSION = 1
+
+# record statuses (never 0: a zero status byte marks a torn append)
+_S_PENDING = 1
+_S_OPENED = 2
+_S_REJECTED = 3
+_STATUS_NAME = {_S_PENDING: "pending", _S_OPENED: "opened",
+                _S_REJECTED: "rejected"}
+
+# 64-byte idx record: status, out_writer, reserved, token, envelope
+# blob (off, len) in .dat, outcome blob (off, len) in out_writer's
+# .out, submitted/opened timestamps
+_REC = struct.Struct("<BBH16sQIQIdd4x")
+REC_SIZE = _REC.size
+_IDX_HDR = b"DTVRIDX1" + b"\x00" * 8
+IDX_HDR_SIZE = len(_IDX_HDR)
+
+_MAX_OPEN_FDS = 64
+
+assert REC_SIZE == 64, REC_SIZE
+assert IDX_HDR_SIZE == 16
+
+
+# ---------------------------------------------------------------- shards
+# Token-range partitioning for multi-worker sweeps. The shard space is
+# [0, 2^256) per the serving spec; tokens are 128-bit blake2b digests
+# (service.envelope_token) that embed at the TOP of the space, so the
+# 256-bit shard bounds project onto 32-hex-char token bounds exactly
+# (shard k's token range is [ceil(lo/2^128), ceil(hi/2^128)) — adjacent
+# shards share the ceiling, so the projection stays disjoint+covering).
+
+SHARD_SPACE_BITS = 256
+TOKEN_HEX_CHARS = 32
+_SPACE = 1 << SHARD_SPACE_BITS
+_TOKEN_SPACE = 1 << (4 * TOKEN_HEX_CHARS)
+_PROJ = _SPACE // _TOKEN_SPACE
+
+
+def shard_bounds(index: int, count: int) -> tuple[int, int]:
+    """[lo, hi) of shard ``index`` of ``count`` over [0, 2^256)."""
+    if count < 1 or not 0 <= index < count:
+        raise ValueError(f"bad shard {index}/{count}")
+    return _SPACE * index // count, _SPACE * (index + 1) // count
+
+
+def shard_hex_bounds(index: int, count: int) -> tuple[str, str | None]:
+    """Shard bounds projected onto 32-hex-char tokens: ``(lo_hex,
+    hi_hex)`` with ``hi_hex None`` for the top shard (no upper bound).
+    Both backends filter with plain string compares — lowercase hex of
+    equal length orders identically to the integers."""
+    lo, hi = shard_bounds(index, count)
+    lo_t = -(-lo // _PROJ)
+    hi_t = -(-hi // _PROJ)
+    lo_hex = format(lo_t, "032x")
+    hi_hex = None if hi_t >= _TOKEN_SPACE else format(hi_t, "032x")
+    return lo_hex, hi_hex
+
+
+def token_in_shard(token: str, index: int, count: int) -> bool:
+    lo_hex, hi_hex = shard_hex_bounds(index, count)
+    return token >= lo_hex and (hi_hex is None or token < hi_hex)
+
+
+def _raw_token(token: str) -> bytes:
+    """Tokens are 32-hex blake2b digests (service.envelope_token); the
+    fixed-width record embeds the 16 raw bytes. Anything else cannot
+    round-trip through the record and is rejected up front."""
+    if not isinstance(token, str) or len(token) != TOKEN_HEX_CHARS:
+        raise VaultError(
+            f"segment vault tokens are {TOKEN_HEX_CHARS}-char hex "
+            f"ciphertext ids, got {token!r}")
+    try:
+        return bytes.fromhex(token)
+    except ValueError:
+        raise VaultError(
+            f"segment vault tokens are {TOKEN_HEX_CHARS}-char hex "
+            f"ciphertext ids, got {token!r}")
+
+
+# ------------------------------------------------------------ hash index
+
+class _TableTorn(Exception):
+    """index.tbl unreadable/mismatched — rebuild from idx files."""
+
+
+class _HashIndex:
+    """Open-addressing token index: mmap'd file of 24-byte slots.
+
+    slot = (token_prefix8, round+1, seq, writer) — ``round+1`` doubles
+    as the occupancy flag (rounds start at 1, so 0 = empty). Linear
+    probing; no deletes (rows are write-once). The prefix is only a
+    filter: the caller verifies the full token against the idx record,
+    so a prefix collision just probes on. Grows by rewrite+rename at
+    load 0.5 so foreign readers can detect replacement via st_ino."""
+
+    _HDR = struct.Struct("<8sQQ8x")
+    _SLOT = struct.Struct("<8sQIH2x")
+    _MAGIC = b"DTVLTBL1"
+    _MIN_SLOTS = 1024
+
+    def __init__(self, path: str, create: bool):
+        self._path = path
+        self._mm: mmap.mmap | None = None
+        self._fd = -1
+        self.nslots = 0
+        self.used = 0
+        if not os.path.exists(path):
+            if not create:
+                raise _TableTorn(f"no table at {path}")
+            self._write_fresh(self._MIN_SLOTS)
+        self._open()
+
+    def _write_fresh(self, nslots: int) -> None:
+        tmp = self._path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(self._HDR.pack(self._MAGIC, nslots, 0))
+            f.truncate(self._HDR.size + nslots * self._SLOT.size)
+        os.replace(tmp, self._path)
+
+    def _open(self) -> None:
+        self.close()
+        self._fd = os.open(self._path, os.O_RDWR)
+        size = os.fstat(self._fd).st_size
+        if size < self._HDR.size:
+            os.close(self._fd)
+            self._fd = -1
+            raise _TableTorn(f"truncated table {self._path}")
+        self._mm = mmap.mmap(self._fd, size)
+        magic, nslots, used = self._HDR.unpack_from(self._mm, 0)
+        if (magic != self._MAGIC or nslots < 1
+                or nslots & (nslots - 1)
+                or size != self._HDR.size + nslots * self._SLOT.size):
+            self.close()
+            raise _TableTorn(f"bad table header {self._path}")
+        self.nslots = nslots
+        self.used = used
+
+    def close(self) -> None:
+        if self._mm is not None:
+            self._mm.flush()
+            self._mm.close()
+            self._mm = None
+        if self._fd >= 0:
+            os.close(self._fd)
+            self._fd = -1
+
+    def flush(self) -> None:
+        if self._mm is not None:
+            self._HDR.pack_into(self._mm, 0, self._MAGIC, self.nslots,
+                                self.used)
+
+    # -- probing ------------------------------------------------------
+    _ZERO8 = b"\x00" * 8
+
+    def candidates(self, raw: bytes) -> list[tuple[int, int, int]]:
+        """Every (writer, round, seq) whose prefix matches ``raw`` —
+        verified against the idx record by the caller. The probe loop
+        compares raw bytes and unpacks a slot only on a prefix hit, so
+        the displacement path (every probe but the last at load 0.5)
+        costs two 8-byte slices, not a 4-object struct tuple — this is
+        the innermost loop of every status() read."""
+        mm, hdr, size = self._mm, self._HDR.size, self._SLOT.size
+        mask = self.nslots - 1
+        p8 = raw[:8]
+        i = int.from_bytes(p8, "big") & mask
+        out = []
+        for _ in range(self.nslots):
+            off = hdr + i * size
+            if mm[off + 8:off + 16] == self._ZERO8:  # round+1 == 0: empty
+                break
+            if mm[off:off + 8] == p8:
+                _, rd1, seq, wid = self._SLOT.unpack_from(mm, off)
+                out.append((wid, rd1 - 1, seq))
+            i = (i + 1) & mask
+        return out
+
+    def insert(self, raw: bytes, round_no: int, seq: int,
+               writer: int) -> None:
+        if (self.used + 1) * 2 >= self.nslots:
+            self._grow(self.nslots * 4)
+        mm, hdr, slot = self._mm, self._HDR.size, self._SLOT
+        mask = self.nslots - 1
+        i = int.from_bytes(raw[:8], "big") & mask
+        rec = slot.pack(raw[:8], round_no + 1, seq, writer)
+        for _ in range(self.nslots + 1):
+            off = hdr + i * slot.size
+            if mm[off + 8:off + 16] == b"\x00" * 8:  # round+1 == 0
+                mm[off:off + slot.size] = rec
+                self.used += 1
+                return
+            if mm[off:off + slot.size] == rec:
+                return  # exact duplicate (heal replay)
+            i = (i + 1) & mask
+        raise VaultError("token index full (grow failed?)")
+
+    def reserve(self, extra: int) -> None:
+        """Pre-size for ``extra`` further inserts (bulk loads: one
+        rebuild instead of log-many)."""
+        need = (self.used + extra) * 2 + 1
+        target = self.nslots
+        while target < need:
+            target *= 2
+        if target > self.nslots:
+            self._grow(target)
+
+    def _grow(self, nslots: int) -> None:
+        hdr, slot = self._HDR.size, self._SLOT
+        buf = bytearray(hdr + nslots * slot.size)
+        self._HDR.pack_into(buf, 0, self._MAGIC, nslots, self.used)
+        mask = nslots - 1
+        old = self._mm
+        for j in range(self.nslots):
+            off = hdr + j * slot.size
+            rec = old[off:off + slot.size]
+            if rec[8:16] == b"\x00" * 8:
+                continue
+            i = int.from_bytes(rec[:8], "big") & mask
+            while True:
+                noff = hdr + i * slot.size
+                if buf[noff + 8:noff + 16] == b"\x00" * 8:
+                    buf[noff:noff + slot.size] = rec
+                    break
+                i = (i + 1) & mask
+        tmp = self._path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(buf)
+        os.replace(tmp, self._path)
+        self._open()
+
+
+# ----------------------------------------------------------- the vault
+
+class _Fd:
+    """Raw fd + tracked end offset (appends are pwrites at the end —
+    no user-space buffer, so every write reaches the OS immediately)."""
+
+    __slots__ = ("fd", "end")
+
+    def __init__(self, fd: int):
+        self.fd = fd
+        self.end = os.fstat(fd).st_size
+
+
+class SegmentVault:
+    """Drop-in :class:`~.vault.TimelockVault` replacement over per-round
+    segment files (module docstring has the layout). ``writer_id``
+    names this process's exclusive append directory."""
+
+    def __init__(self, path: str, writer_id: int = 0):
+        if not 0 <= int(writer_id) < 100:
+            raise VaultError(f"writer_id out of range: {writer_id}")
+        self._dir = path
+        self._wid = int(writer_id)
+        os.makedirs(path, exist_ok=True)
+        self._check_meta()
+        self._wdir = os.path.join(path, f"w{self._wid:02d}")
+        os.makedirs(os.path.join(self._wdir, "rounds"), exist_ok=True)
+        self._lock = threading.Lock()
+        self._fds: dict[tuple[int, int, str], _Fd] = {}  # LRU, cap 64
+        self._tables: dict[int, _HashIndex] = {}
+        self._table_sig: dict[int, tuple] = {}
+        self._counter_fds: dict[int, _Fd] = {}
+        self._writer_ids: list[int] = []
+        self._closed = False
+        # bound child resolved once: labels() is a lock + dict probe
+        # per call, measurable on the O(1) get path it would meter
+        from .. import metrics
+
+        self._reads_inc = metrics.VAULT_READS.labels(
+            backend="segment").inc
+        self._refresh_writers()
+        with self._lock:
+            self._own_table()
+            sub, op, rej = self._read_counters(self._wid)
+            self._c_sub, self._c_op, self._c_rej = sub, op, rej
+
+    # ------------------------------------------------------- plumbing
+    def _check_meta(self) -> None:
+        meta_path = os.path.join(self._dir, META_FILE)
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            if meta.get("version") != _META_VERSION:
+                raise VaultError(
+                    f"vault segment dir {self._dir} is version "
+                    f"{meta.get('version')!r}, this build speaks "
+                    f"v{_META_VERSION}")
+        else:
+            with open(meta_path, "w") as f:
+                json.dump({"version": _META_VERSION,
+                           "kind": "timelock-vault"}, f)
+
+    def _refresh_writers(self) -> None:
+        ids = []
+        for name in os.listdir(self._dir):
+            if len(name) == 3 and name[0] == "w" and name[1:].isdigit():
+                ids.append(int(name[1:]))
+        if self._wid not in ids:
+            ids.append(self._wid)
+        self._writer_ids = sorted(ids)
+
+    def _round_path(self, wid: int, rd: int, ext: str) -> str:
+        wdir = self._wdir if wid == self._wid else \
+            os.path.join(self._dir, f"w{wid:02d}")
+        return os.path.join(wdir, "rounds", f"r-{rd:012d}.{ext}")
+
+    def _fh(self, wid: int, rd: int, ext: str,
+            create: bool = False) -> _Fd | None:
+        key = (wid, rd, ext)
+        fh = self._fds.get(key)
+        if fh is not None:
+            # LRU re-insert (dicts preserve order; pop+set = move to end)
+            del self._fds[key]
+            self._fds[key] = fh
+            return fh
+        path = self._round_path(wid, rd, ext)
+        flags = os.O_RDWR
+        if create and wid == self._wid:
+            flags |= os.O_CREAT
+        try:
+            fd = os.open(path, flags, 0o644)
+        except FileNotFoundError:
+            return None
+        fh = _Fd(fd)
+        if ext == "idx" and fh.end == 0:
+            os.pwrite(fd, _IDX_HDR, 0)
+            fh.end = IDX_HDR_SIZE
+        while len(self._fds) >= _MAX_OPEN_FDS:
+            oldest = next(iter(self._fds))
+            os.close(self._fds.pop(oldest).fd)
+        self._fds[key] = fh
+        return fh
+
+    def _append(self, fh: _Fd, blob: bytes) -> tuple[int, int]:
+        os.pwrite(fh.fd, blob, fh.end)
+        off = fh.end
+        fh.end += len(blob)
+        return off, len(blob)
+
+    def _idx_count(self, fh: _Fd, wid: int) -> int:
+        end = fh.end if wid == self._wid else os.fstat(fh.fd).st_size
+        return max(0, (end - IDX_HDR_SIZE)) // REC_SIZE
+
+    def _read_entry(self, fh: _Fd, seq: int):
+        data = os.pread(fh.fd, REC_SIZE, IDX_HDR_SIZE + seq * REC_SIZE)
+        if len(data) != REC_SIZE:
+            return None
+        return _REC.unpack(data)
+
+    def _write_entry(self, fh: _Fd, seq: int, rec: bytes) -> None:
+        os.pwrite(fh.fd, rec, IDX_HDR_SIZE + seq * REC_SIZE)
+
+    # -- hash tables --------------------------------------------------
+    def _own_table(self) -> _HashIndex:
+        tbl = self._tables.get(self._wid)
+        if tbl is None:
+            path = os.path.join(self._wdir, "index.tbl")
+            try:
+                tbl = _HashIndex(path, create=True)
+            except _TableTorn:
+                os.unlink(path)
+                tbl = _HashIndex(path, create=True)
+                self._rebuild_table(tbl)
+            self._tables[self._wid] = tbl
+        return tbl
+
+    def _rebuild_table(self, tbl: _HashIndex) -> None:
+        """Re-index every own idx record (torn table recovery)."""
+        for rd in self._rounds_of(self._wid):
+            fh = self._fh(self._wid, rd, "idx")
+            if fh is None:
+                continue
+            for seq in range(self._idx_count(fh, self._wid)):
+                e = self._read_entry(fh, seq)
+                if e is not None and e[0] in _STATUS_NAME:
+                    tbl.insert(e[3], rd, seq, self._wid)
+        tbl.flush()
+
+    def _table(self, wid: int) -> _HashIndex | None:
+        if wid == self._wid:
+            return self._own_table()
+        path = os.path.join(self._dir, f"w{wid:02d}", "index.tbl")
+        try:
+            st = os.stat(path)
+        except FileNotFoundError:
+            tbl = self._tables.pop(wid, None)
+            if tbl is not None:
+                tbl.close()
+            return None
+        sig = (st.st_ino, st.st_size)
+        if self._table_sig.get(wid) != sig:
+            tbl = self._tables.pop(wid, None)
+            if tbl is not None:
+                tbl.close()
+            try:
+                self._tables[wid] = _HashIndex(path, create=False)
+                self._table_sig[wid] = sig
+            except _TableTorn:
+                return None
+        return self._tables.get(wid)
+
+    # -- counters -----------------------------------------------------
+    def _counter_fh(self, wid: int) -> _Fd | None:
+        fh = self._counter_fds.get(wid)
+        if fh is None:
+            path = os.path.join(self._dir, f"w{wid:02d}", "counters.bin")
+            flags = os.O_RDWR | (os.O_CREAT if wid == self._wid else 0)
+            try:
+                fd = os.open(path, flags, 0o644)
+            except FileNotFoundError:
+                return None
+            fh = _Fd(fd)
+            self._counter_fds[wid] = fh
+        return fh
+
+    def _read_counters(self, wid: int) -> tuple[int, int, int]:
+        fh = self._counter_fh(wid)
+        if fh is None:
+            return 0, 0, 0
+        data = os.pread(fh.fd, 24, 0)
+        if len(data) < 24:
+            return 0, 0, 0
+        return struct.unpack("<QQQ", data)
+
+    def _write_counters(self) -> None:
+        fh = self._counter_fh(self._wid)
+        os.pwrite(fh.fd, struct.pack(
+            "<QQQ", self._c_sub, self._c_op, self._c_rej), 0)
+
+    # -- rounds -------------------------------------------------------
+    def _rounds_of(self, wid: int) -> list[int]:
+        rdir = os.path.join(self._dir, f"w{wid:02d}", "rounds")
+        try:
+            names = os.listdir(rdir)
+        except FileNotFoundError:
+            return []
+        out = []
+        for name in names:
+            if name.startswith("r-") and name.endswith(".idx"):
+                out.append(int(name[2:-4]))
+        return sorted(out)
+
+    def _all_rounds(self) -> list[int]:
+        rounds: set[int] = set()
+        for wid in self._writer_ids:
+            rounds.update(self._rounds_of(wid))
+        return sorted(rounds)
+
+    def _done_total(self, rd: int) -> int | None:
+        """Max recorded done-marker count for a round, None if none."""
+        best = None
+        for wid in self._writer_ids:
+            path = self._round_path(wid, rd, "done")
+            try:
+                with open(path) as f:
+                    n = int(f.read().strip() or 0)
+            except (FileNotFoundError, ValueError):
+                continue
+            best = n if best is None else max(best, n)
+        return best
+
+    def _round_totals(self, rd: int) -> tuple[int, int]:
+        """(total entries, pending entries) for a round, all writers."""
+        total = pending = 0
+        for wid in self._writer_ids:
+            fh = self._fh(wid, rd, "idx")
+            if fh is None:
+                continue
+            n = self._idx_count(fh, wid)
+            total += n
+            if n:
+                data = os.pread(fh.fd, n * REC_SIZE, IDX_HDR_SIZE)
+                pending += sum(
+                    1 for i in range(len(data) // REC_SIZE)
+                    if data[i * REC_SIZE] == _S_PENDING)
+        return total, pending
+
+    def _mark_done(self, rd: int) -> None:
+        total, pending = self._round_totals(rd)
+        if pending == 0 and total > 0:
+            path = self._round_path(self._wid, rd, "done")
+            with open(path, "w") as f:
+                f.write(str(total))
+
+    # -- location -----------------------------------------------------
+    def _locate(self, raw: bytes, round_hint: int | None = None
+                ) -> list[tuple[int, int, int, tuple, bool]]:
+        """Every idx record holding this token, across writers:
+        (entry_writer, round, seq, record, via_scan). Retries once
+        after a writer-list refresh (another worker's directory may
+        have appeared since init)."""
+        for attempt in (0, 1):
+            seen: set[tuple[int, int, int]] = set()
+            out = []
+            for wid in self._writer_ids:
+                tbl = self._table(wid)
+                if tbl is None:
+                    continue
+                for ewid, rd, seq in tbl.candidates(raw):
+                    key = (ewid, rd, seq)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    fh = self._fh(ewid, rd, "idx")
+                    if fh is None:
+                        continue
+                    e = self._read_entry(fh, seq)
+                    if e is not None and e[3] == raw:
+                        out.append((ewid, rd, seq, e, False))
+            if not out and round_hint is not None:
+                # torn hash (crash between the idx append and the
+                # insert): the record is still authoritative — scan
+                # the hinted round
+                for wid in self._writer_ids:
+                    fh = self._fh(wid, round_hint, "idx")
+                    if fh is None:
+                        continue
+                    for seq in range(self._idx_count(fh, wid)):
+                        e = self._read_entry(fh, seq)
+                        if e is not None and e[3] == raw:
+                            out.append((wid, round_hint, seq, e, True))
+            if out or attempt:
+                return out
+            self._refresh_writers()
+        return []
+
+    # ------------------------------------------------------ public API
+    def __len__(self) -> int:
+        with self._lock:
+            self._refresh_writers()
+            total = 0
+            for wid in self._writer_ids:
+                if wid == self._wid:
+                    total += self._c_sub
+                else:
+                    total += self._read_counters(wid)[0]
+            return total
+
+    def submit(self, token: str, round_no: int, envelope: dict) -> bool:
+        raw = _raw_token(token)
+        with self._lock:
+            if self._locate(raw):
+                return False
+            blob = json.dumps(envelope, sort_keys=True).encode()
+            dat = self._fh(self._wid, round_no, "dat", create=True)
+            off, ln = self._append(dat, blob)
+            idx = self._fh(self._wid, round_no, "idx", create=True)
+            seq = self._idx_count(idx, self._wid)
+            rec = _REC.pack(_S_PENDING, 0, 0, raw, off, ln, 0, 0,
+                            time.time(), 0.0)
+            self._write_entry(idx, seq, rec)
+            idx.end = IDX_HDR_SIZE + (seq + 1) * REC_SIZE
+            tbl = self._own_table()
+            tbl.insert(raw, round_no, seq, self._wid)
+            tbl.flush()
+            self._c_sub += 1
+            self._write_counters()
+            return True
+
+    def get(self, token: str, with_envelope: bool = True) -> dict | None:
+        try:
+            raw = _raw_token(token)
+        except VaultError:
+            return None  # a shape no row can have = unknown id
+        with self._lock:
+            locs = self._locate(raw)
+            if not locs:
+                return None
+            self._reads_inc()
+            # a decided copy wins over a pending duplicate (immutable
+            # rows are the serving surface; duplicates only arise from
+            # a cross-worker double-submit race)
+            if len(locs) > 1:
+                locs.sort(
+                    key=lambda loc: 0 if loc[3][0] != _S_PENDING else 1)
+            ewid, rd, seq, e, _ = locs[0]
+            (status, out_writer, _r0, _tok, env_off, env_len,
+             out_off, out_len, submitted, opened_ts) = e
+            rec = {"id": token, "round": rd, "envelope": None,
+                   "status": _STATUS_NAME.get(status, "pending"),
+                   "plaintext": None, "error": None,
+                   "submitted": submitted,
+                   "opened": opened_ts if status != _S_PENDING else None}
+            if with_envelope:
+                dat = self._fh(ewid, rd, "dat")
+                if dat is not None:
+                    rec["envelope"] = json.loads(
+                        os.pread(dat.fd, env_len, env_off))
+            if status != _S_PENDING:
+                out = self._fh(out_writer, rd, "out")
+                blob = (os.pread(out.fd, out_len, out_off)
+                        if out is not None else b"")
+                if status == _S_OPENED:
+                    rec["plaintext"] = blob
+                else:
+                    rec["error"] = blob.decode("utf-8", "replace")
+            return rec
+
+    def pending_rounds(self, up_to: int | None = None) -> list[int]:
+        with self._lock:
+            self._refresh_writers()
+            out = []
+            for rd in self._all_rounds():
+                if up_to is not None and rd > up_to:
+                    continue
+                total, pending = self._round_totals(rd)
+                done = self._done_total(rd)
+                if done is not None and done == total and pending == 0:
+                    continue
+                if pending:
+                    out.append(rd)
+                elif total:
+                    # fully decided but unmarked (opener crashed before
+                    # its marker): write ours so the sweep stops
+                    # rescanning this round forever
+                    self._mark_done(rd)
+            return out
+
+    def pending_for_round(self, round_no: int,
+                          shard: tuple[int, int] | None = None
+                          ) -> list[tuple[str, dict]]:
+        lo_hex = hi_hex = None
+        if shard is not None:
+            lo_hex, hi_hex = shard_hex_bounds(*shard)
+        with self._lock:
+            self._refresh_writers()
+            out = []
+            for wid in self._writer_ids:
+                fh = self._fh(wid, round_no, "idx")
+                if fh is None:
+                    continue
+                dat = self._fh(wid, round_no, "dat")
+                for seq in range(self._idx_count(fh, wid)):
+                    e = self._read_entry(fh, seq)
+                    if e is None or e[0] != _S_PENDING:
+                        continue
+                    tok = e[3].hex()
+                    if lo_hex is not None and (
+                            tok < lo_hex
+                            or (hi_hex is not None and tok >= hi_hex)):
+                        continue
+                    env = json.loads(os.pread(dat.fd, e[5], e[4]))
+                    out.append((e[8], tok, env))
+            out.sort(key=lambda t: (t[0], t[1]))
+            return [(tok, env) for _, tok, env in out]
+
+    def pending_count(self) -> int:
+        with self._lock:
+            self._refresh_writers()
+            sub = op = rej = 0
+            for wid in self._writer_ids:
+                if wid == self._wid:
+                    sub += self._c_sub
+                    op += self._c_op
+                    rej += self._c_rej
+                else:
+                    s, o, r = self._read_counters(wid)
+                    sub += s
+                    op += o
+                    rej += r
+            return max(0, sub - op - rej)
+
+    def finish_round(self, results: list[tuple[str, bool, bytes, str]],
+                     round_no: int | None = None) -> tuple[int, int]:
+        """Persist open outcomes; only pending records transition (rows
+        already decided by a concurrent sweep are skipped, matching the
+        SQLite backend). Outcome blobs land in THIS writer's .out files
+        first, then the 64-byte entry flips in place — a crash between
+        the two leaves the row pending and the next sweep re-opens it
+        (the orphan blob is harmless)."""
+        now = time.time()
+        opened = rejected = 0
+        touched: set[int] = set()
+        with self._lock:
+            for token, ok, plaintext, error in results:
+                raw = _raw_token(token)
+                locs = [loc for loc in self._locate(raw, round_no)
+                        if loc[3][0] == _S_PENDING]
+                if not locs:
+                    continue
+                blob = (plaintext if ok
+                        else (error or "")[:300].encode())
+                out_fh = self._fh(self._wid, locs[0][1], "out",
+                                  create=True)
+                out_off, out_len = self._append(out_fh, blob)
+                for ewid, rd, seq, e, via_scan in locs:
+                    rec = _REC.pack(
+                        _S_OPENED if ok else _S_REJECTED, self._wid, 0,
+                        raw, e[4], e[5], out_off, out_len, e[8], now)
+                    fh = self._fh(ewid, rd, "idx")
+                    self._write_entry(fh, seq, rec)
+                    touched.add(rd)
+                    if via_scan:
+                        # heal the torn hash so status() finds the
+                        # decided row without a hint
+                        tbl = self._own_table()
+                        tbl.insert(raw, rd, seq, ewid)
+                        tbl.flush()
+                if ok:
+                    opened += 1
+                else:
+                    rejected += 1
+            self._c_op += opened
+            self._c_rej += rejected
+            self._write_counters()
+            for rd in touched:
+                self._mark_done(rd)
+        return opened, rejected
+
+    def set_opened(self, token: str, plaintext: bytes) -> None:
+        self._finish_one(token, True, plaintext, None)
+
+    def set_rejected(self, token: str, error: str) -> None:
+        self._finish_one(token, False, None, error)
+
+    def _finish_one(self, token: str, ok: bool,
+                    plaintext: bytes | None, error: str | None) -> None:
+        opened, rejected = self.finish_round(
+            [(token, ok, plaintext or b"", error or "")])
+        if opened + rejected != 1:
+            raise VaultError(
+                f"ciphertext {token} is not pending (double open?)")
+
+    # -- migration ----------------------------------------------------
+    def rows(self):
+        """Every record, ordered by (round, submitted, token) — the
+        migration surface. Envelopes come back as their RAW stored JSON
+        string so SQLite<->segment round-trips are byte-exact with zero
+        re-encoding."""
+        with self._lock:
+            self._refresh_writers()
+            rounds = self._all_rounds()
+        for rd in rounds:
+            with self._lock:
+                recs = []
+                for wid in self._writer_ids:
+                    fh = self._fh(wid, rd, "idx")
+                    if fh is None:
+                        continue
+                    dat = self._fh(wid, rd, "dat")
+                    for seq in range(self._idx_count(fh, wid)):
+                        e = self._read_entry(fh, seq)
+                        if e is None or e[0] not in _STATUS_NAME:
+                            continue
+                        env = os.pread(dat.fd, e[5], e[4]).decode()
+                        plaintext = error = None
+                        if e[0] != _S_PENDING:
+                            out = self._fh(e[1], rd, "out")
+                            blob = (os.pread(out.fd, e[7], e[6])
+                                    if out is not None else b"")
+                            if e[0] == _S_OPENED:
+                                plaintext = blob
+                            else:
+                                error = blob.decode("utf-8", "replace")
+                        recs.append({
+                            "id": e[3].hex(), "round": rd,
+                            "envelope": env,
+                            "status": _STATUS_NAME[e[0]],
+                            "plaintext": plaintext, "error": error,
+                            "submitted": e[8],
+                            "opened": e[9] if e[0] != _S_PENDING
+                            else None,
+                        })
+            recs.sort(key=lambda r: (r["submitted"], r["id"]))
+            yield from recs
+
+    def put_rows(self, rows, size_hint: int | None = None) -> int:
+        """Bulk-load full records (migration / bench fixtures) into
+        THIS writer's directory. No per-row duplicate check — sources
+        are vaults, whose ids are unique by construction."""
+        count = 0
+        with self._lock:
+            tbl = self._own_table()
+            if size_hint:
+                tbl.reserve(size_hint)
+            touched: set[int] = set()
+            for rec in rows:
+                raw = _raw_token(rec["id"])
+                rd = rec["round"]
+                env = rec["envelope"]
+                blob = (env.encode() if isinstance(env, str)
+                        else json.dumps(env, sort_keys=True).encode())
+                dat = self._fh(self._wid, rd, "dat", create=True)
+                env_off, env_len = self._append(dat, blob)
+                status = {"pending": _S_PENDING, "opened": _S_OPENED,
+                          "rejected": _S_REJECTED}.get(rec["status"])
+                if status is None:
+                    raise VaultError(
+                        f"unknown row status {rec['status']!r}")
+                out_off = out_len = 0
+                if status != _S_PENDING:
+                    ob = (rec["plaintext"] if status == _S_OPENED
+                          else (rec["error"] or "").encode())
+                    out = self._fh(self._wid, rd, "out", create=True)
+                    out_off, out_len = self._append(out, ob or b"")
+                idx = self._fh(self._wid, rd, "idx", create=True)
+                seq = self._idx_count(idx, self._wid)
+                self._write_entry(idx, seq, _REC.pack(
+                    status, self._wid, 0, raw, env_off, env_len,
+                    out_off, out_len, rec["submitted"],
+                    rec["opened"] or 0.0))
+                idx.end = IDX_HDR_SIZE + (seq + 1) * REC_SIZE
+                tbl.insert(raw, rd, seq, self._wid)
+                self._c_sub += 1
+                if status == _S_OPENED:
+                    self._c_op += 1
+                elif status == _S_REJECTED:
+                    self._c_rej += 1
+                touched.add(rd)
+                count += 1
+            tbl.flush()
+            self._write_counters()
+            for rd in touched:
+                self._mark_done(rd)
+        return count
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            for fh in self._fds.values():
+                os.close(fh.fd)
+            self._fds.clear()
+            for tbl in self._tables.values():
+                tbl.flush()
+                tbl.close()
+            self._tables.clear()
+            for fh in self._counter_fds.values():
+                os.close(fh.fd)
+            self._counter_fds.clear()
+
+
+# ------------------------------------------------------------- factory
+
+def is_segment_vault(path: str) -> bool:
+    return os.path.isdir(path) and os.path.exists(
+        os.path.join(path, META_FILE))
+
+
+def open_vault(path: str, writer_id: int = 0):
+    """The one place backend selection happens: explicit
+    ``DRAND_TPU_TIMELOCK_STORE=segment`` opts in, an existing segment
+    dir at ``path`` keeps opening as one (a daemon restarted WITHOUT
+    the env var must not silently start a fresh SQLite vault next to
+    its data), SQLite stays the default."""
+    backend = os.environ.get("DRAND_TPU_TIMELOCK_STORE", "").strip()
+    if backend not in ("", "sqlite", "segment"):
+        raise VaultError(
+            f"unknown DRAND_TPU_TIMELOCK_STORE={backend!r} "
+            f"(sqlite|segment)")
+    if backend == "segment" or is_segment_vault(path):
+        return SegmentVault(path, writer_id=writer_id)
+    return TimelockVault(path)
+
+
+def migrate_vault(src, dst) -> int:
+    """Copy every row src -> dst (either backend direction). Returns
+    the row count."""
+    size_hint = len(src)
+    if isinstance(dst, SegmentVault):
+        return dst.put_rows(src.rows(), size_hint=size_hint)
+    return dst.put_rows(src.rows())

@@ -3,10 +3,13 @@
 One :class:`TimelockService` fronts a :class:`~.vault.TimelockVault` and
 a chain client. Submissions are validated against the chain (scheme
 version, envelope shape, the cross-chain ``chain_hash`` binding, size
-caps) and persisted pending; when the chain reaches a round, EVERY
-pending ciphertext for it opens in one ``crypto/batch.decrypt_round_batch``
-dispatch (device GT graph or host shared-signature tier — both hoist the
-round signature's Miller work out of the per-item loop).
+caps) and persisted pending; when the chain reaches a round, every
+pending ciphertext for it (this worker's token shard of them, when the
+sweep is partitioned) opens in ceil(K/DRAND_TPU_TIMELOCK_OPEN_CHUNK)
+``crypto/batch.decrypt_round_batch`` dispatches (device GT graph or
+host shared-signature tier — both hoist the round signature's Miller
+work out of the per-item loop), each followed by its own vault commit
+and a cooperative yield (ISSUE 20 bounded opens).
 
 Round boundaries arrive two ways, both funnelling into the same
 idempotent sweep:
@@ -98,7 +101,8 @@ def envelope_token(envelope: dict) -> str:
 
 class TimelockService:
     def __init__(self, vault: TimelockVault, client: Client,
-                 logger: KVLogger | None = None):
+                 logger: KVLogger | None = None,
+                 shard: tuple[int, int] | None = None):
         self._vault = vault
         self._client = client
         self._l = logger or default_logger("timelock")
@@ -107,6 +111,25 @@ class TimelockService:
         self._head = 0  # last chain head this service has seen
         self._tasks: set[asyncio.Future] = set()  # in-flight sweeps
         self._loop: asyncio.AbstractEventLoop | None = None
+        # sweep partition (ISSUE 20): (index, count) restricts every
+        # open to that token-range shard so `relay --workers K` workers
+        # each drain a disjoint slice of a round instead of electing
+        # worker 0 the sole sweeper; None = the whole token space
+        if shard is not None and not 0 <= shard[0] < shard[1]:
+            raise ValueError(f"bad timelock shard {shard}")
+        self._shard = shard
+        # bounded boundary opens: at most this many ciphertexts per
+        # batched dispatch, a vault commit + cooperative yield between
+        # chunks. Unset OR set-but-empty both mean the bounded default
+        # (clearing the var is "reset", not an escape hatch); only an
+        # explicit 0 selects the pre-ISSUE-20 unbounded monolithic open
+        self._open_chunk = int(os.environ.get(
+            "DRAND_TPU_TIMELOCK_OPEN_CHUNK") or 2048)
+        # open-notify hook (http_server/fanout.TimelockNotifyHub):
+        # called on the service loop with [(token, status, round)]
+        # after each chunk COMMITS — a notified client re-fetching
+        # GET /timelock/{id} always sees the decided row
+        self._notify = None
 
     # ------------------------------------------------------------ lifecycle
     async def start(self) -> None:
@@ -121,6 +144,8 @@ class TimelockService:
 
         metrics.TIMELOCK_PENDING.set(
             await asyncio.to_thread(self._vault.pending_count))
+        metrics.TIMELOCK_SWEEP_SHARDS.set(
+            self._shard[1] if self._shard else 1)
         self._spawn_sweep(name="timelock-catchup")
 
     async def close(self) -> None:
@@ -138,6 +163,26 @@ class TimelockService:
         task = spawn(self._sweep(result), name=name)
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
+
+    def set_notifier(self, cb) -> None:
+        """Wire the open-notify hub (PublicServer does this before
+        start): ``cb([(token, status, round), ...])`` fires on the
+        service loop after each chunk's vault commit."""
+        self._notify = cb
+
+    def opens_locally(self, token: str) -> bool:
+        """True when THIS service's sweep is the one that decides
+        ``token`` — unsharded, or the token falls inside this worker's
+        token-range shard — so its open event will reach this
+        process's notify hub. False means the open commits in ANOTHER
+        worker process; the watch handler then falls back to polling
+        the shared vault instead of waiting on a hub that will never
+        publish for this id."""
+        if self._shard is None:
+            return True
+        from .segvault import token_in_shard
+
+        return token_in_shard(token, *self._shard)
 
     async def info(self):
         if self._info is None:
@@ -175,7 +220,8 @@ class TimelockService:
         # idempotent-retry lookup BEFORE the backlog cap: a client
         # retrying an already-accepted submission must get its status
         # back even when the vault is full (retries cluster under load)
-        if await asyncio.to_thread(self._vault.get, token) is not None:
+        if await asyncio.to_thread(self._vault.get, token,
+                                   False) is not None:
             return await self.status(token)
         pending = await asyncio.to_thread(self._vault.pending_count)
         if pending >= MAX_PENDING:
@@ -202,7 +248,10 @@ class TimelockService:
     async def status(self, token: str) -> dict | None:
         """The public status record for one ciphertext id (None =
         unknown id)."""
-        rec = await asyncio.to_thread(self._vault.get, token)
+        # with_envelope=False: the status record never returns the
+        # envelope, and skipping it keeps the lookup one O(1) seek on
+        # the segment backend
+        rec = await asyncio.to_thread(self._vault.get, token, False)
         if rec is None:
             return None
         out = {"id": rec["id"], "round": rec["round"],
@@ -286,9 +335,15 @@ class TimelockService:
                 self._opening.discard(rd)
 
     async def _open_round(self, round_no: int, r: Result) -> None:
-        """ONE batched dispatch opens the round's pending set."""
+        """Drain the round's pending set (this worker's shard of it) in
+        ceil(K/chunk) batched dispatches. Each chunk is one
+        decrypt_round_batch dispatch followed by ITS OWN vault commit
+        and a cooperative yield — the loop is never held across a
+        chunk (p99 submit latency during a sweep stays bounded), and a
+        crash mid-open resumes from the last committed chunk because
+        committed rows are no longer pending."""
         items = await asyncio.to_thread(
-            self._vault.pending_for_round, round_no)
+            self._vault.pending_for_round, round_no, self._shard)
         if not items:
             return
         from .. import metrics
@@ -316,16 +371,37 @@ class TimelockService:
                              id=token, err=str(e))
         if not cts:
             return
-        outcomes = await asyncio.to_thread(
-            batch.decrypt_round_batch, r.signature_v2, cts)
-        # ONE vault transaction for the whole round (a 10k-ciphertext
-        # round must not pay 10k thread hops + 10k commits after a
-        # single batched decrypt)
-        results = [(token, ok, plaintext, err)
-                   for token, (ok, plaintext, err)
-                   in zip(good, outcomes)]
-        opened, rejected = await asyncio.to_thread(
-            self._vault.finish_round, results)
+        chunk = self._open_chunk if self._open_chunk > 0 else len(cts)
+        opened = rejected = 0
+        for base in range(0, len(cts), chunk):
+            # the slice is already <= chunk; chunk=0 tells batch not to
+            # re-split (the commit-per-chunk discipline lives HERE)
+            outcomes = await asyncio.to_thread(
+                batch.decrypt_round_batch, r.signature_v2,
+                cts[base:base + chunk], 0)
+            metrics.TIMELOCK_OPEN_DISPATCHES.inc()
+            results = [(token, ok, plaintext, err)
+                       for token, (ok, plaintext, err)
+                       in zip(good[base:base + chunk], outcomes)]
+            # one vault transaction PER CHUNK: rows decided so far stay
+            # decided if the next dispatch (or the process) dies, and a
+            # restart's catch-up sweep only re-opens the remainder
+            c_opened, c_rejected = await asyncio.to_thread(
+                self._vault.finish_round, results, round_no)
+            opened += c_opened
+            rejected += c_rejected
+            if self._notify is not None:
+                try:
+                    self._notify(
+                        [(token, "opened" if ok else "rejected",
+                          round_no) for token, ok, _, _ in results])
+                except Exception as e:  # noqa: BLE001 — push is best-effort
+                    self._l.warn("timelock", "notify_failed",
+                                 round=round_no,
+                                 err=f"{type(e).__name__}: {e}")
+            # cooperative yield between chunks: queued submits/status
+            # reads run before the next dispatch is scheduled
+            await asyncio.sleep(0)
         if opened:
             metrics.TIMELOCK_CIPHERTEXTS.labels(result="opened").inc(opened)
         if rejected:

@@ -55,6 +55,12 @@ class TimelockVault:
         self._conn.execute("PRAGMA synchronous=NORMAL")
         self._conn.commit()
         self._lock = threading.Lock()
+        # bound child resolved once (same hoist as the segment
+        # backend: labels() is a lock + dict probe per get)
+        from .. import metrics
+
+        self._reads_inc = metrics.VAULT_READS.labels(
+            backend="sqlite").inc
 
     def __len__(self) -> int:
         with self._lock:
@@ -76,7 +82,9 @@ class TimelockVault:
             self._conn.commit()
             return cur.rowcount == 1
 
-    def get(self, token: str) -> dict | None:
+    def get(self, token: str, with_envelope: bool = True) -> dict | None:
+        """One record by id. ``with_envelope=False`` skips decoding the
+        envelope JSON (the status() serving path never returns it)."""
         with self._lock:
             row = self._conn.execute(
                 "SELECT id, round, envelope, status, plaintext, error,"
@@ -84,9 +92,11 @@ class TimelockVault:
                 (token,)).fetchone()
         if row is None:
             return None
+        self._reads_inc()
         return {
             "id": row[0], "round": row[1],
-            "envelope": json.loads(row[2]), "status": row[3],
+            "envelope": json.loads(row[2]) if with_envelope else None,
+            "status": row[3],
             "plaintext": row[4], "error": row[5],
             "submitted": row[6], "opened": row[7],
         }
@@ -103,13 +113,28 @@ class TimelockVault:
             rows = self._conn.execute(q + " ORDER BY round", args).fetchall()
         return [r[0] for r in rows]
 
-    def pending_for_round(self, round_no: int) -> list[tuple[str, dict]]:
-        """(token, envelope) of every pending ciphertext for a round."""
+    def pending_for_round(self, round_no: int,
+                          shard: tuple[int, int] | None = None
+                          ) -> list[tuple[str, dict]]:
+        """(token, envelope) of every pending ciphertext for a round;
+        ``shard=(index, count)`` restricts to that token-range partition
+        (segvault.shard_hex_bounds — hex ids of equal length order like
+        the integers, so plain string compares partition exactly)."""
+        q = ("SELECT id, envelope FROM timelock"
+             " WHERE round = ? AND status = 'pending'")
+        args: list = [round_no]
+        if shard is not None:
+            from .segvault import shard_hex_bounds
+
+            lo_hex, hi_hex = shard_hex_bounds(*shard)
+            q += " AND id >= ?"
+            args.append(lo_hex)
+            if hi_hex is not None:
+                q += " AND id < ?"
+                args.append(hi_hex)
         with self._lock:
             rows = self._conn.execute(
-                "SELECT id, envelope FROM timelock"
-                " WHERE round = ? AND status = 'pending' ORDER BY submitted",
-                (round_no,)).fetchall()
+                q + " ORDER BY submitted, id", args).fetchall()
         return [(r[0], json.loads(r[1])) for r in rows]
 
     def pending_count(self) -> int:
@@ -119,13 +144,16 @@ class TimelockVault:
             ).fetchone()
         return n
 
-    def finish_round(self, results: list[tuple[str, bool, bytes, str]]
-                     ) -> tuple[int, int]:
-        """Persist a whole round's open outcomes in ONE transaction:
-        ``(token, ok, plaintext, error)`` rows become opened/rejected.
-        Returns (opened, rejected) counts. Only ``pending`` rows
-        transition (immutability as in :meth:`_finish`); rows already
-        decided by a concurrent sweep are skipped, not an error."""
+    def finish_round(self, results: list[tuple[str, bool, bytes, str]],
+                     round_no: int | None = None) -> tuple[int, int]:
+        """Persist a round's open outcomes (one chunk's worth) in ONE
+        transaction: ``(token, ok, plaintext, error)`` rows become
+        opened/rejected. Returns (opened, rejected) counts. Only
+        ``pending`` rows transition (immutability as in
+        :meth:`_finish`); rows already decided by a concurrent sweep
+        are skipped, not an error. ``round_no`` is the segment
+        backend's torn-index recovery hint — unused here, the PK index
+        finds rows regardless."""
         now = time.time()
         opened = rejected = 0
         with self._lock:
@@ -164,6 +192,61 @@ class TimelockVault:
             if cur.rowcount != 1:
                 raise VaultError(
                     f"ciphertext {token} is not pending (double open?)")
+
+    def rows(self):
+        """Every record in INSERTION (rowid) order — the migration
+        surface (segvault.migrate_vault; the segment backend's rows()
+        orders by (round, submitted, token) instead, so callers must
+        not rely on a cross-backend order). Envelopes come back as
+        their RAW stored JSON string so SQLite<->segment round-trips
+        are byte-exact with zero re-encoding."""
+        last_rowid = 0
+        while True:
+            with self._lock:
+                batch = self._conn.execute(
+                    "SELECT rowid, id, round, envelope, status,"
+                    " plaintext, error, submitted, opened FROM timelock"
+                    " WHERE rowid > ? ORDER BY rowid LIMIT 4096",
+                    (last_rowid,)).fetchall()
+            if not batch:
+                return
+            last_rowid = batch[-1][0]
+            for r in batch:
+                yield {
+                    "id": r[1], "round": r[2], "envelope": r[3],
+                    "status": r[4], "plaintext": r[5], "error": r[6],
+                    "submitted": r[7], "opened": r[8],
+                }
+
+    def put_rows(self, rows) -> int:
+        """Bulk-load full records (migration / bench fixtures),
+        batched executemany transactions. Envelope may arrive as its
+        raw JSON string (the rows() shape) or a dict."""
+        count = 0
+        batch: list[tuple] = []
+
+        def _flush() -> None:
+            with self._lock:
+                self._conn.executemany(
+                    "INSERT OR IGNORE INTO timelock (id, round,"
+                    " envelope, status, plaintext, error, submitted,"
+                    " opened) VALUES (?, ?, ?, ?, ?, ?, ?, ?)", batch)
+                self._conn.commit()
+            batch.clear()
+
+        for rec in rows:
+            env = rec["envelope"]
+            if not isinstance(env, str):
+                env = json.dumps(env, sort_keys=True)
+            batch.append((rec["id"], rec["round"], env, rec["status"],
+                          rec["plaintext"], rec["error"],
+                          rec["submitted"], rec["opened"]))
+            count += 1
+            if len(batch) >= 10_000:
+                _flush()
+        if batch:
+            _flush()
+        return count
 
     def close(self) -> None:
         with self._lock:

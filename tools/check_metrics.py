@@ -102,8 +102,14 @@ KNOWN_LABEL_VALUES = {
     # reasons literal at both shed sites, the store backend literal in
     # each backend's read path
     "relay_wakeups_total": {"proto": {"sse", "ndjson"}},
-    "relay_shed_total": {"reason": {"watcher_cap", "slow_consumer"}},
+    "relay_shed_total": {"reason": {"watcher_cap", "slow_consumer",
+                                    "timelock_slow"}},
     "chain_store_reads_total": {"backend": {"sqlite", "segment"}},
+    # timelock at scale (ISSUE 20): vault reads literal in each
+    # backend's get() path, notify events branch-literal in
+    # TimelockNotifyHub.publish_open
+    "vault_reads_total": {"backend": {"sqlite", "segment"}},
+    "timelock_notify_total": {"event": {"opened", "rejected"}},
     # incident engine (ISSUE 15): every rule carries its canonical
     # severity at a branch-literal call site (obs/incident.py
     # _incident_counter — the flight.py label-helper pattern); unknown

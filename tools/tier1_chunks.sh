@@ -117,6 +117,24 @@
 #                          quorum repair, stale serving (host-only,
 #                          structural crypto; ~5 s)
 #   test_zz_timelock_serve.py  timelock serving tier
+#   test_zz_vault_scale.py segment timelock vault (ISSUE 20): shard
+#                          math coverage, SQLite<->segment CLI
+#                          migration equivalence both directions,
+#                          O(1)-at-depth status/pending_count,
+#                          chunked-open crash resume, two-worker
+#                          partitioned sweep, SSE open-notify +
+#                          shedding, restart persistence (host-pinned
+#                          by an autouse fixture; real crypto only on
+#                          handfuls of ciphertexts; ~30 s). CONFLICTS
+#                          evaluation vs test_daemon/
+#                          test_mock_and_scale: pure tmp_path vaults
+#                          and in-process aiohttp TestClient, no DKG/
+#                          reshare phasers, no wall-clock timers
+#                          beyond short sweep polls; vs
+#                          test_zz_timelock_serve: same host-pinned
+#                          batch fixture pattern, per-test vault
+#                          dirs — coexists in one chunk fine; no pair
+#                          entry needed.
 #
 # Exit status: 0 iff every chunk passed.
 
